@@ -1,0 +1,22 @@
+"""nats_llm_studio_tpu — a TPU-native LLM serving framework controlled over NATS.
+
+Re-implements the capability surface of the reference (Dsouza10082/nats-llm-studio:
+NATS request-reply subjects ``lmstudio.list_models`` / ``pull_model`` /
+``delete_model`` / ``chat_model``, JetStream Object Store model distribution,
+queue-group scale-out — see /root/reference/nats_llm_studio.go and README.md)
+with an in-process JAX/XLA inference engine instead of an external LM Studio
+GPU server.
+
+Layout:
+  transport/  NATS wire-protocol client + embedded broker + worker runtime
+  gguf/       GGUF v3 reader/writer, block (de)quantization, tokenizer
+  models/     model architectures (Llama-3, Granite, Mixtral) in pure JAX
+  ops/        numeric building blocks incl. Pallas TPU kernels
+  engine/     KV cache, bucketed prefill, batched decode, sampling
+  parallel/   device mesh + sharding rules (TP/EP/DP) over ICI/DCN
+  serve/      NATS worker: handlers, continuous batcher, streaming
+  store/      object store (model blob repository) + model registry
+  utils/      small shared helpers
+"""
+
+__version__ = "0.1.0"

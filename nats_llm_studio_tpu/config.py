@@ -1,0 +1,51 @@
+"""Environment-variable configuration.
+
+The reference's config contract is README-only (env vars ``NATS_URL``,
+``LMSTUDIO_BASE_URL``, ``LMSTUDIO_MODELS_DIR``, ``NATS_QUEUE_GROUP`` with
+defaults — /root/reference/README.md:489-494, materialized into ``.env`` by
+scripts/setup_unix.sh:111-115). This build keeps the same names and defaults,
+drops ``LMSTUDIO_BASE_URL`` (no external HTTP engine exists any more), and
+adds TPU-mesh settings.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+def _env(name: str, default: str) -> str:
+    v = os.environ.get(name, "").strip()
+    return v or default
+
+
+@dataclass
+class WorkerConfig:
+    # reference-compatible contract (README.md:489-494)
+    nats_url: str = field(default_factory=lambda: _env("NATS_URL", "nats://127.0.0.1:4222"))
+    models_dir: Path = field(
+        default_factory=lambda: Path(
+            _env("LMSTUDIO_MODELS_DIR", str(Path.home() / ".lmstudio" / "models"))
+        ).expanduser()
+    )
+    queue_group: str = field(default_factory=lambda: _env("NATS_QUEUE_GROUP", "lmstudio-workers"))
+    subject_prefix: str = field(default_factory=lambda: _env("SUBJECT_PREFIX", "lmstudio"))
+
+    # object store (README.md:250-318 pattern)
+    bucket: str = field(default_factory=lambda: _env("MODEL_BUCKET", "llm-models"))
+
+    # TPU build additions
+    mesh_shape: str = field(default_factory=lambda: _env("TPU_MESH", ""))  # e.g. "tp=8" or "dp=2,tp=4"
+    max_batch_slots: int = field(default_factory=lambda: int(_env("MAX_BATCH_SLOTS", "8")))
+    max_seq_len: int = field(default_factory=lambda: int(_env("MAX_SEQ_LEN", "4096")))
+
+    # timeout ladder — mirrors the reference's per-op deadlines
+    # (nats_llm_studio.go:229, :251, :289, :328)
+    list_timeout_s: float = 30.0
+    pull_timeout_s: float = 600.0
+    delete_timeout_s: float = 120.0
+    chat_timeout_s: float = 120.0
+
+    def subject(self, op: str) -> str:
+        return f"{self.subject_prefix}.{op}"

@@ -1,0 +1,4 @@
+from .api import ChatEngine, EngineError, ModelNotFound, Registry
+from .worker import Worker
+
+__all__ = ["ChatEngine", "EngineError", "ModelNotFound", "Registry", "Worker"]

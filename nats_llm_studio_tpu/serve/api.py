@@ -1,0 +1,80 @@
+"""Engine and registry interfaces the NATS handler layer is written against.
+
+The reference's handler layer talks to an ``LMStudioClient`` interface
+(PullModel/DeleteModel/ListModels/Chat — /root/reference/nats_llm_studio.go:22-179)
+that proxies to an external process. Here the same four capabilities are an
+in-process ``Registry`` managing ``ChatEngine`` instances (the TPU decode
+loops). Tests substitute fakes at this seam (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, AsyncIterator
+
+
+class EngineError(Exception):
+    """Inference/registry failure carried into the error envelope."""
+
+
+class ModelNotFound(EngineError):
+    pass
+
+
+class ChatEngine(ABC):
+    """A loaded model able to serve OpenAI-style chat completions."""
+
+    model_id: str
+
+    @abstractmethod
+    async def chat(self, payload: dict) -> dict:
+        """Full (non-streaming) completion for an OpenAI-style chat payload
+        (the reference passes this payload verbatim to LM Studio,
+        nats_llm_studio.go:161; response shape README.md:208-231)."""
+
+    async def chat_stream(self, payload: dict) -> AsyncIterator[dict]:
+        """Yield OpenAI-style chunk dicts; default shim yields the full
+        completion as one chunk."""
+        yield await self.chat(payload)
+
+    @abstractmethod
+    def info(self) -> dict:
+        """LM-Studio-shaped model entry (id, object, publisher, state, ...;
+        README.md:66-80)."""
+
+    async def unload(self) -> None:
+        """Release device memory."""
+
+
+class Registry(ABC):
+    """Model lifecycle: the in-process replacement for LM Studio + `lms` CLI."""
+
+    @abstractmethod
+    async def list_models(self) -> dict:
+        """LM-Studio-shaped listing: ``{"object": "list", "data": [...]}``."""
+
+    @abstractmethod
+    async def pull(self, identifier: str) -> str:
+        """Fetch a model into the local cache (object store / path import).
+        Returns a human-readable transcript — the analog of `lms get`'s
+        combined output (nats_llm_studio.go:53-55)."""
+
+    @abstractmethod
+    async def delete(self, model_id: str) -> str:
+        """Unload + remove from local cache. Returns the deleted directory
+        (the reference returns ``deleted_dir``, nats_llm_studio.go:316-323).
+        Raises EngineError with the attempted dir in ``.dir`` when missing."""
+
+    @abstractmethod
+    async def get_engine(self, model_id: str) -> ChatEngine:
+        """Return a loaded engine for ``model_id``, loading it if cached on
+        disk; raise ModelNotFound otherwise."""
+
+    async def sync_from_bucket(self, name: str, model_id: str | None = None) -> str:
+        """Object-store → local cache download; returns local path
+        (the conceptual ``lmstudio.sync_model_from_bucket`` subject,
+        /root/reference/README.md:286-318)."""
+        raise EngineError("object store not configured")
+
+    def stats(self) -> dict[str, Any]:
+        return {}

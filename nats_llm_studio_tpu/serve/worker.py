@@ -1,0 +1,278 @@
+"""NATS worker runtime: the handler layer the reference leaves unwritten.
+
+The reference is a library with no ``main()``/``Subscribe`` (SURVEY.md §1);
+its README specifies the runtime: connect to ``NATS_URL``, queue-subscribe the
+subjects under ``NATS_QUEUE_GROUP`` (/root/reference/README.md:475-494). This
+module implements that contract plus the handler semantics of
+/root/reference/nats_llm_studio.go:228-364:
+
+* uniform ``{ok, error?, data?}`` envelope (``:186-190``)
+* validation branches and error strings (``:254-262, :293-300, :331-345``) —
+  with the Portuguese "payload vazio em ChatModel" (``:332``) consciously
+  normalized to English (deviation documented in SURVEY.md §2.1)
+* per-op deadline ladder: list 30 s / pull 10 min / delete 2 min / chat 2 min
+  (``:229, :251, :289, :328``)
+* subjects: the four from README.md:17-21, the conceptual
+  ``sync_model_from_bucket`` (README.md:286-318) made real, and a ``health``
+  subject (SURVEY.md §5 failure-detection gap).
+
+Streaming: when the chat payload sets ``"stream": true``, tokens are published
+to the reply inbox as OpenAI-style chunks and the terminal message carries the
+full aggregate completion with a ``Nats-Stream-Done`` header — so naive
+single-reply clients (``nats req``) still receive a complete response.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from ..config import WorkerConfig
+from ..transport.client import Msg, NatsClient, connect
+from ..transport.envelope import envelope_error, envelope_ok
+from .api import EngineError, ModelNotFound, Registry
+
+log = logging.getLogger(__name__)
+
+
+class Worker:
+    """One serving process: NATS subscriptions + an in-process model registry."""
+
+    def __init__(self, config: WorkerConfig, registry: Registry):
+        self.config = config
+        self.registry = registry
+        self.nc: NatsClient | None = None
+        self._started = asyncio.Event()
+        self._stop = asyncio.Event()
+        self._requests_total = 0
+        self._tokens_total = 0
+        self._t0 = time.monotonic()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        cfg = self.config
+        self.nc = await connect(cfg.nats_url, name="tpu-worker")
+        q = cfg.queue_group
+        subs = {
+            cfg.subject("list_models"): self.on_list_models,
+            cfg.subject("pull_model"): self.on_pull_model,
+            cfg.subject("delete_model"): self.on_delete_model,
+            cfg.subject("chat_model"): self.on_chat_model,
+            cfg.subject("sync_model_from_bucket"): self.on_sync_model_from_bucket,
+            cfg.subject("health"): self.on_health,
+        }
+        for subject, handler in subs.items():
+            await self.nc.subscribe(subject, queue=q, cb=handler)
+        await self.nc.flush()
+        self._started.set()
+        log.info("worker serving %s.* (queue=%s)", cfg.subject_prefix, q)
+
+    async def run(self) -> None:
+        await self.start()
+        await self._stop.wait()
+        await self.drain()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    async def drain(self) -> None:
+        if self.nc is not None:
+            await self.nc.drain()
+
+    # -- envelope helpers ----------------------------------------------------
+
+    async def _respond_json(self, msg: Msg, payload: bytes) -> None:
+        try:
+            await msg.respond(payload)
+        except (ConnectionError, ValueError):
+            log.warning("failed to respond on %s", msg.subject)
+
+    async def _respond_ok(self, msg: Msg, data=None) -> None:
+        await self._respond_json(msg, envelope_ok(data))
+
+    async def _respond_error(self, msg: Msg, error: str, data=None) -> None:
+        await self._respond_json(msg, envelope_error(error, data))
+
+    # -- handlers ------------------------------------------------------------
+
+    async def on_list_models(self, msg: Msg) -> None:
+        """list_models → wraps the registry listing as ``data.models`` +
+        ``data.http_status`` (nats_llm_studio.go:240-247 shape, status fixed
+        at 200 since no HTTP hop exists any more)."""
+        self._requests_total += 1
+        try:
+            async with asyncio.timeout(self.config.list_timeout_s):
+                models = await self.registry.list_models()
+        except asyncio.TimeoutError:
+            await self._respond_error(msg, "timeout listing models")
+            return
+        except EngineError as e:
+            await self._respond_error(msg, f"error listing models: {e}")
+            return
+        await self._respond_ok(msg, {"models": models, "http_status": 200})
+
+    async def on_pull_model(self, msg: Msg) -> None:
+        """pull_model {identifier} — nats_llm_studio.go:250-286. On failure the
+        data still carries {model, output} (:266-275)."""
+        self._requests_total += 1
+        try:
+            req = json.loads(msg.payload or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in PullModel: {e}")
+            return
+        identifier = (req.get("identifier") or "").strip()
+        if not identifier:
+            await self._respond_error(msg, "'identifier' is required")
+            return
+        try:
+            async with asyncio.timeout(self.config.pull_timeout_s):
+                output = await self.registry.pull(identifier)
+        except asyncio.TimeoutError:
+            await self._respond_error(
+                msg, "error pulling model: deadline exceeded", {"model": identifier}
+            )
+            return
+        except EngineError as e:
+            await self._respond_error(
+                msg, f"error pulling model: {e}", {"model": identifier, "output": str(e)}
+            )
+            return
+        await self._respond_ok(msg, {"model": identifier, "output": output})
+
+    async def on_delete_model(self, msg: Msg) -> None:
+        """delete_model {model_id} — nats_llm_studio.go:288-324. Error
+        responses include the attempted dir (:304-313); success returns
+        ``deleted_dir`` (:316-323)."""
+        self._requests_total += 1
+        try:
+            req = json.loads(msg.payload or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in DeleteModel: {e}")
+            return
+        model_id = (req.get("model_id") or "").strip()
+        if not model_id:
+            await self._respond_error(msg, "'model_id' is required")
+            return
+        try:
+            async with asyncio.timeout(self.config.delete_timeout_s):
+                deleted_dir = await self.registry.delete(model_id)
+        except asyncio.TimeoutError:
+            await self._respond_error(msg, "error deleting model: deadline exceeded", {"model": model_id})
+            return
+        except EngineError as e:
+            data = {"model": model_id}
+            attempted = getattr(e, "dir", None)
+            if attempted:
+                data["dir"] = str(attempted)
+            await self._respond_error(msg, f"error deleting model: {e}", data)
+            return
+        await self._respond_ok(msg, {"model": model_id, "deleted_dir": deleted_dir})
+
+    async def on_chat_model(self, msg: Msg) -> None:
+        """chat_model — nats_llm_studio.go:327-364. Payload is the OpenAI-style
+        body passed through to the engine verbatim (:348); success wraps
+        {http_status, response} (:356-362)."""
+        self._requests_total += 1
+        if not msg.payload:
+            await self._respond_error(msg, "empty payload in ChatModel")
+            return
+        try:
+            payload = json.loads(msg.payload)
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in ChatModel: {e}")
+            return
+        model_id = (payload.get("model") or "").strip()
+        if not model_id:
+            await self._respond_error(msg, "'model' is required in ChatModel")
+            return
+        try:
+            async with asyncio.timeout(self.config.chat_timeout_s):
+                engine = await self.registry.get_engine(model_id)
+                if payload.get("stream"):
+                    await self._chat_streaming(msg, engine, payload)
+                else:
+                    response = await engine.chat(payload)
+                    usage = response.get("usage") or {}
+                    self._tokens_total += usage.get("completion_tokens", 0)
+                    await self._respond_ok(msg, {"http_status": 200, "response": response})
+        except asyncio.TimeoutError:
+            await self._respond_error(msg, "error in chat: deadline exceeded", {"model": model_id})
+        except ModelNotFound as e:
+            await self._respond_error(msg, f"model not found: {e}", {"model": model_id})
+        except EngineError as e:
+            await self._respond_error(msg, f"error in chat: {e}", {"model": model_id})
+
+    async def _chat_streaming(self, msg: Msg, engine, payload: dict) -> None:
+        assert self.nc is not None
+        if not msg.reply:
+            return
+        final: dict | None = None
+        seq = 0
+        async for chunk in engine.chat_stream(payload):
+            if chunk.get("object") == "chat.completion":
+                final = chunk  # engines yield the aggregate last
+                continue
+            await self.nc.publish(
+                msg.reply,
+                json.dumps({"ok": True, "data": {"chunk": chunk}}, separators=(",", ":")).encode(),
+                headers={"X-Seq": str(seq)},
+            )
+            seq += 1
+        if final is None:
+            final = await engine.chat(payload)
+        usage = final.get("usage") or {}
+        self._tokens_total += usage.get("completion_tokens", 0)
+        await self.nc.publish(
+            msg.reply,
+            envelope_ok({"http_status": 200, "response": final}),
+            headers={"Nats-Stream-Done": "1", "X-Seq": str(seq)},
+        )
+
+    async def on_sync_model_from_bucket(self, msg: Msg) -> None:
+        """sync_model_from_bucket {object_name, model_id?} — implements the
+        README-only conceptual subject (/root/reference/README.md:286-318):
+        object store → local model cache, responds {local_path}."""
+        self._requests_total += 1
+        try:
+            req = json.loads(msg.payload or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in SyncModelFromBucket: {e}")
+            return
+        name = (req.get("object_name") or req.get("name") or "").strip()
+        if not name:
+            await self._respond_error(msg, "'object_name' is required")
+            return
+        try:
+            async with asyncio.timeout(self.config.pull_timeout_s):
+                local_path = await self.registry.sync_from_bucket(name, req.get("model_id"))
+        except asyncio.TimeoutError:
+            await self._respond_error(msg, "error syncing model: deadline exceeded", {"object": name})
+            return
+        except EngineError as e:
+            await self._respond_error(msg, f"error syncing model: {e}", {"object": name})
+            return
+        await self._respond_ok(msg, {"object": name, "local_path": str(local_path)})
+
+    async def on_health(self, msg: Msg) -> None:
+        """health — heartbeat + counters (SURVEY.md §5: the reference has no
+        health subject; client timeout is its only failure detector)."""
+        data = {
+            "status": "ok",
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "requests_total": self._requests_total,
+            "tokens_total": self._tokens_total,
+            "queue_group": self.config.queue_group,
+        }
+        data.update(self.registry.stats())
+        await self._respond_ok(msg, data)

@@ -1,0 +1,13 @@
+from .client import Msg, NatsClient, Subscription, connect
+from .broker import EmbeddedBroker
+from .envelope import envelope_error, envelope_ok
+
+__all__ = [
+    "Msg",
+    "NatsClient",
+    "Subscription",
+    "connect",
+    "EmbeddedBroker",
+    "envelope_error",
+    "envelope_ok",
+]

@@ -1,0 +1,249 @@
+"""Embedded NATS broker: core pub/sub, wildcards, queue groups, headers.
+
+The reference requires an external ``nats-server`` binary (installed and
+launched by /root/reference/scripts/setup_unix.sh:72-102). This build ships a
+wire-compatible broker in-tree so the whole stack — tests, benchmarks, and
+single-host deployments — runs hermetically with zero external processes.
+Queue-group delivery (one random member per group per message) reproduces the
+competing-consumers scale-out contract (/root/reference/README.md:478-484).
+
+The broker also hosts server-side modules (e.g. the object store,
+``store/objectstore.py``) which register internal handlers on API subjects —
+the in-tree analog of nats-server's JetStream subsystem.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+from ..utils import subject_matches, valid_subject
+from . import protocol as p
+
+log = logging.getLogger(__name__)
+
+MAX_PAYLOAD = 8 * 1024 * 1024  # > default 1 MiB: model blob chunks ride NATS
+
+
+@dataclass(slots=True)
+class _Sub:
+    client: "_ClientConn"
+    sid: str
+    subject: str
+    queue: str | None
+    remaining: int | None = None  # auto-unsub countdown
+
+
+class _ClientConn:
+    def __init__(self, broker: "EmbeddedBroker", reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.broker = broker
+        self.reader = reader
+        self.writer = writer
+        self.parser = p.Parser()
+        self.subs: dict[str, _Sub] = {}
+        self.cid = broker._next_cid()
+        self.closed = False
+        self._out = asyncio.Queue[bytes | None]()
+        self._writer_task: asyncio.Task | None = None
+
+    def send(self, data: bytes) -> None:
+        if not self.closed:
+            self._out.put_nowait(data)
+
+    async def _write_loop(self) -> None:
+        try:
+            while True:
+                data = await self._out.get()
+                if data is None:
+                    break
+                # coalesce pending writes
+                chunks = [data]
+                while not self._out.empty():
+                    nxt = self._out.get_nowait()
+                    if nxt is None:
+                        break
+                    chunks.append(nxt)
+                self.writer.write(b"".join(chunks))
+                await self.writer.drain()
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+
+    async def run(self) -> None:
+        self._writer_task = asyncio.ensure_future(self._write_loop())
+        info = {
+            "server_id": self.broker.server_id,
+            "server_name": "nats-llm-studio-tpu-embedded",
+            "version": "2.10.12-compat",
+            "proto": 1,
+            "headers": True,
+            "max_payload": self.broker.max_payload,
+            "client_id": self.cid,
+        }
+        self.send(p.encode_info(info))
+        try:
+            while True:
+                data = await self.reader.read(64 * 1024)
+                if not data:
+                    break
+                for ev in self.parser.feed(data):
+                    await self._handle(ev)
+        except (ConnectionError, OSError, p.ProtocolError) as e:
+            if isinstance(e, p.ProtocolError):
+                self.send(p.encode_err(str(e)))
+        finally:
+            await self._close()
+
+    async def _close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for sub in list(self.subs.values()):
+            self.broker._remove_sub(sub)
+        self.subs.clear()
+        self.broker._clients.discard(self)
+        self._out.put_nowait(None)
+        if self._writer_task:
+            try:
+                await asyncio.wait_for(self._writer_task, 1.0)
+            except asyncio.TimeoutError:
+                self._writer_task.cancel()
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+    async def _handle(self, ev: p.Event) -> None:
+        if isinstance(ev, p.MsgEvent):  # PUB / HPUB
+            if len(ev.payload) > self.broker.max_payload:
+                self.send(p.encode_err("Maximum Payload Violation"))
+                return
+            await self.broker.route(ev.subject, ev.payload, ev.reply, ev.headers)
+        elif isinstance(ev, p.SubEvent):
+            if not valid_subject(ev.subject, allow_wildcards=True):
+                self.send(p.encode_err(f"Invalid Subject: {ev.subject}"))
+                return
+            sub = _Sub(self, ev.sid, ev.subject, ev.queue)
+            self.subs[ev.sid] = sub
+            self.broker._add_sub(sub)
+        elif isinstance(ev, p.UnsubEvent):
+            sub = self.subs.get(ev.sid)
+            if sub is None:
+                return
+            if ev.max_msgs is None:
+                del self.subs[ev.sid]
+                self.broker._remove_sub(sub)
+            else:
+                sub.remaining = ev.max_msgs
+        elif isinstance(ev, p.CtrlEvent):
+            if ev.op == "PING":
+                self.send(p.PONG)
+        elif isinstance(ev, p.ConnectEvent):
+            pass  # no auth in embedded mode
+
+
+InternalHandler = Callable[[str, bytes, str | None, dict[str, str] | None], Awaitable[None]]
+
+
+class EmbeddedBroker:
+    """In-process NATS-compatible broker. ``await start()`` binds the port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, max_payload: int = MAX_PAYLOAD):
+        self.host = host
+        self.port = port
+        self.max_payload = max_payload
+        self.server_id = f"EMB{random.getrandbits(48):012X}"
+        self._server: asyncio.base_events.Server | None = None
+        self._clients: set[_ClientConn] = set()
+        self._subs: list[_Sub] = []
+        self._cid = 0
+        # internal modules: (pattern, handler) — called in-process, no socket
+        self._internal: list[tuple[str, InternalHandler]] = []
+
+    @property
+    def url(self) -> str:
+        return f"nats://{self.host}:{self.port}"
+
+    def _next_cid(self) -> int:
+        self._cid += 1
+        return self._cid
+
+    async def start(self) -> "EmbeddedBroker":
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for c in list(self._clients):
+            await c._close()
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = _ClientConn(self, reader, writer)
+        self._clients.add(conn)
+        await conn.run()
+
+    # -- interest management -------------------------------------------------
+
+    def _add_sub(self, sub: _Sub) -> None:
+        self._subs.append(sub)
+
+    def _remove_sub(self, sub: _Sub) -> None:
+        try:
+            self._subs.remove(sub)
+        except ValueError:
+            pass
+
+    def register_internal(self, pattern: str, handler: InternalHandler) -> None:
+        """Register a server-side module handler (object store, health...)."""
+        self._internal.append((pattern, handler))
+
+    # -- routing -------------------------------------------------------------
+
+    async def route(
+        self,
+        subject: str,
+        payload: bytes,
+        reply: str | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> None:
+        """Deliver a message: plain subs each get a copy; queue groups get one
+        randomly-chosen member (README.md:478-484 semantics)."""
+        plain: list[_Sub] = []
+        groups: dict[tuple[str, str], list[_Sub]] = {}
+        for sub in self._subs:
+            if sub.client.closed or not subject_matches(sub.subject, subject):
+                continue
+            if sub.queue:
+                groups.setdefault((sub.subject, sub.queue), []).append(sub)
+            else:
+                plain.append(sub)
+        targets = plain + [random.choice(members) for members in groups.values()]
+        for sub in targets:
+            sub.client.send(p.encode_msg(subject, sub.sid, payload, reply, headers))
+            if sub.remaining is not None:
+                sub.remaining -= 1
+                if sub.remaining <= 0:
+                    sub.client.subs.pop(sub.sid, None)
+                    self._remove_sub(sub)
+        for pattern, handler in self._internal:
+            if subject_matches(pattern, subject):
+                try:
+                    await handler(subject, payload, reply, headers)
+                except Exception:  # module errors must not kill the router
+                    log.exception("internal handler error on %s", subject)
+
+    async def publish_internal(
+        self,
+        subject: str,
+        payload: bytes,
+        reply: str | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> None:
+        """Publish from a server-side module."""
+        await self.route(subject, payload, reply, headers)

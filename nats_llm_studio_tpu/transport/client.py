@@ -1,0 +1,326 @@
+"""Asyncio NATS client: pub/sub, queue groups, request-reply, streaming requests.
+
+Provides the client capabilities the reference gets from nats.go v1.47.0
+(/root/reference/go.mod:8): ``Publish``/``Subscribe``/``QueueSubscribe``/
+``Request`` with a muxed ``_INBOX.<nuid>.*`` reply subscription, plus
+``request_stream`` — the multi-reply extension the TPU build uses for token
+streaming (SURVEY.md §7 hard-part 3): many messages arrive on the reply inbox
+and the terminal one carries a ``Nats-Stream-Done`` header with the aggregate,
+so naive single-reply clients still see a complete response.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Awaitable, Callable
+from urllib.parse import urlparse
+
+from ..utils import next_nuid
+from . import protocol as p
+
+
+@dataclass(slots=True)
+class Msg:
+    subject: str
+    payload: bytes
+    reply: str | None = None
+    headers: dict[str, str] | None = None
+    _client: "NatsClient | None" = None
+
+    def json(self):
+        return json.loads(self.payload or b"null")
+
+    async def respond(self, payload: bytes, headers: dict[str, str] | None = None) -> None:
+        """Reply via this message's own connection — mirrors msg.Respond in the
+        reference (/root/reference/nats_llm_studio.go:214)."""
+        if not self.reply:
+            raise ValueError("message has no reply subject")
+        assert self._client is not None
+        await self._client.publish(self.reply, payload, headers=headers)
+
+
+class Subscription:
+    def __init__(self, client: "NatsClient", sid: str, subject: str, queue: str | None):
+        self._client = client
+        self.sid = sid
+        self.subject = subject
+        self.queue = queue
+        self._queue: asyncio.Queue[Msg | None] = asyncio.Queue()
+        self._cb: Callable[[Msg], Awaitable[None]] | None = None
+        self._cb_tasks: set[asyncio.Task] = set()
+        self.closed = False
+
+    def _deliver(self, msg: Msg) -> None:
+        if self._cb is not None:
+            task = asyncio.ensure_future(self._cb(msg))
+            self._cb_tasks.add(task)
+            task.add_done_callback(self._cb_tasks.discard)
+        else:
+            self._queue.put_nowait(msg)
+
+    async def next_msg(self, timeout: float | None = None) -> Msg:
+        if self.closed and self._queue.empty():
+            raise BrokenPipeError("subscription closed")
+        msg = await asyncio.wait_for(self._queue.get(), timeout)
+        if msg is None:
+            raise BrokenPipeError("subscription closed")
+        return msg
+
+    def __aiter__(self) -> AsyncIterator[Msg]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[Msg]:
+        while True:
+            try:
+                yield await self.next_msg()
+            except BrokenPipeError:
+                return
+
+    async def unsubscribe(self) -> None:
+        if not self.closed:
+            self.closed = True
+            await self._client._unsubscribe(self.sid)
+            self._queue.put_nowait(None)
+
+
+class NatsClient:
+    """A single NATS connection."""
+
+    def __init__(self) -> None:
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._parser = p.Parser()
+        self._subs: dict[str, Subscription] = {}
+        self._next_sid = 0
+        self._read_task: asyncio.Task | None = None
+        self._pong_waiters: list[asyncio.Future] = []
+        self._inbox_prefix = f"_INBOX.{next_nuid()}"
+        self._resp_futures: dict[str, asyncio.Future[Msg]] = {}
+        self._resp_sub_started = False
+        self._closed = asyncio.Event()
+        self.server_info: dict = {}
+        self._write_lock = asyncio.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def connect(self, url: str = "nats://127.0.0.1:4222", name: str | None = None) -> None:
+        u = urlparse(url)
+        host = u.hostname or "127.0.0.1"
+        port = u.port or 4222
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        # read INFO
+        line = await self._reader.readline()
+        events = list(self._parser.feed(line))
+        if not events or not isinstance(events[0], p.InfoEvent):
+            raise ConnectionError(f"expected INFO, got {events!r}")
+        self.server_info = events[0].info
+        opts = {
+            "verbose": False,
+            "pedantic": False,
+            "lang": "python-tpu",
+            "version": "0.1.0",
+            "protocol": 1,
+            "headers": True,
+        }
+        if name:
+            opts["name"] = name
+        self._writer.write(p.encode_connect(opts) + p.PING)
+        await self._writer.drain()
+        self._read_task = asyncio.ensure_future(self._read_loop())
+        await self.flush()
+
+    async def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        if self._read_task:
+            self._read_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        for sub in self._subs.values():
+            sub.closed = True
+            sub._queue.put_nowait(None)
+        for fut in self._resp_futures.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("connection closed"))
+
+    async def drain(self) -> None:
+        """Unsubscribe everything, flush, close — graceful worker shutdown
+        (the runtime behavior /root/reference/README.md:475-484 leaves to the
+        embedding application)."""
+        for sub in list(self._subs.values()):
+            await sub.unsubscribe()
+        try:
+            await self.flush()
+        except ConnectionError:
+            pass
+        await self.close()
+
+    # -- core ops -----------------------------------------------------------
+
+    async def _send(self, data: bytes) -> None:
+        if self._writer is None or self._closed.is_set():
+            raise ConnectionError("not connected")
+        async with self._write_lock:
+            self._writer.write(data)
+            await self._writer.drain()
+
+    async def publish(
+        self,
+        subject: str,
+        payload: bytes = b"",
+        reply: str | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> None:
+        await self._send(p.encode_pub(subject, payload, reply, headers))
+
+    async def subscribe(
+        self,
+        subject: str,
+        queue: str | None = None,
+        cb: Callable[[Msg], Awaitable[None]] | None = None,
+    ) -> Subscription:
+        self._next_sid += 1
+        sid = str(self._next_sid)
+        sub = Subscription(self, sid, subject, queue)
+        sub._cb = cb
+        self._subs[sid] = sub
+        await self._send(p.encode_sub(subject, sid, queue))
+        return sub
+
+    async def _unsubscribe(self, sid: str, max_msgs: int | None = None) -> None:
+        self._subs.pop(sid, None) if max_msgs is None else None
+        try:
+            await self._send(p.encode_unsub(sid, max_msgs))
+        except ConnectionError:
+            pass
+
+    async def flush(self, timeout: float = 10.0) -> None:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pong_waiters.append(fut)
+        await self._send(p.PING)
+        await asyncio.wait_for(fut, timeout)
+
+    # -- request-reply ------------------------------------------------------
+
+    async def _ensure_resp_sub(self) -> None:
+        if self._resp_sub_started:
+            return
+        self._resp_sub_started = True
+
+        async def on_resp(msg: Msg) -> None:
+            token = msg.subject.rsplit(".", 1)[-1]
+            fut = self._resp_futures.pop(token, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+
+        await self.subscribe(self._inbox_prefix + ".*", cb=on_resp)
+
+    def new_inbox(self) -> str:
+        return f"_INBOX.{next_nuid()}"
+
+    async def request(
+        self,
+        subject: str,
+        payload: bytes = b"",
+        timeout: float = 2.0,
+        headers: dict[str, str] | None = None,
+    ) -> Msg:
+        """Single request, single reply — the pattern every reference subject
+        uses (/root/reference/README.md:86-88, :131-134, :181-186, :237-245)."""
+        await self._ensure_resp_sub()
+        token = next_nuid()
+        inbox = f"{self._inbox_prefix}.{token}"
+        fut: asyncio.Future[Msg] = asyncio.get_running_loop().create_future()
+        self._resp_futures[token] = fut
+        await self.publish(subject, payload, reply=inbox, headers=headers)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._resp_futures.pop(token, None)
+            raise
+        except BaseException:
+            self._resp_futures.pop(token, None)
+            raise
+
+    async def request_stream(
+        self,
+        subject: str,
+        payload: bytes = b"",
+        timeout: float = 120.0,
+        idle_timeout: float = 30.0,
+    ) -> AsyncIterator[Msg]:
+        """Multi-reply request: yields every message published to the reply
+        inbox until one carries the ``Nats-Stream-Done`` header (the terminal
+        aggregate) or timeout elapses."""
+        inbox = self.new_inbox()
+        sub = await self.subscribe(inbox)
+        await self.publish(subject, payload, reply=inbox)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        try:
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(f"stream request to {subject} timed out")
+                msg = await sub.next_msg(timeout=min(remaining, idle_timeout))
+                yield msg
+                if msg.headers and "Nats-Stream-Done" in msg.headers:
+                    return
+        finally:
+            await sub.unsubscribe()
+
+    # -- read loop ----------------------------------------------------------
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                data = await self._reader.read(64 * 1024)
+                if not data:
+                    break
+                for ev in self._parser.feed(data):
+                    await self._dispatch(ev)
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+        finally:
+            if not self._closed.is_set():
+                await self.close()
+
+    async def _dispatch(self, ev: p.Event) -> None:
+        if isinstance(ev, p.MsgEvent):
+            sub = self._subs.get(ev.sid or "")
+            if sub is not None:
+                sub._deliver(
+                    Msg(
+                        subject=ev.subject,
+                        payload=ev.payload,
+                        reply=ev.reply,
+                        headers=ev.headers,
+                        _client=self,
+                    )
+                )
+        elif isinstance(ev, p.CtrlEvent):
+            if ev.op == "PING":
+                await self._send(p.PONG)
+            elif ev.op == "PONG":
+                while self._pong_waiters:
+                    fut = self._pong_waiters.pop(0)
+                    if not fut.done():
+                        fut.set_result(None)
+                    break
+        elif isinstance(ev, p.ErrEvent):
+            # fatal server errors close the connection; others are logged
+            pass
+
+
+async def connect(url: str = "nats://127.0.0.1:4222", name: str | None = None) -> NatsClient:
+    nc = NatsClient()
+    await nc.connect(url, name=name)
+    return nc

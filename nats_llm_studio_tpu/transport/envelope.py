@@ -1,0 +1,35 @@
+"""The uniform JSON response envelope: ``{ok, error?, data?}``.
+
+Byte-for-byte contract of the reference's ``NATSResponse``
+(/root/reference/nats_llm_studio.go:186-190): ``ok`` always present, ``error``
+and ``data`` omitted when empty. ``FALLBACK`` reproduces the hardcoded
+marshal-failure reply (nats_llm_studio.go:211).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+FALLBACK = b'{"ok":false,"error":"internal serialization error"}'
+
+
+def envelope_ok(data: Any = None) -> bytes:
+    env: dict[str, Any] = {"ok": True}
+    if data is not None:
+        env["data"] = data
+    return _dump(env)
+
+
+def envelope_error(error: str, data: Any = None) -> bytes:
+    env: dict[str, Any] = {"ok": False, "error": error}
+    if data is not None:
+        env["data"] = data
+    return _dump(env)
+
+
+def _dump(env: dict) -> bytes:
+    try:
+        return json.dumps(env, separators=(",", ":")).encode()
+    except (TypeError, ValueError):
+        return FALLBACK

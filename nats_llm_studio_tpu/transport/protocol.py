@@ -1,0 +1,275 @@
+"""NATS wire protocol: incremental parser + serializers.
+
+The reference delegates the wire protocol to nats.go v1.47.0
+(/root/reference/go.mod:8) and an external nats-server binary
+(/root/reference/scripts/setup_unix.sh:72-102). This build ships the protocol
+in-tree: one incremental parser used by both the client (parsing
+INFO/MSG/HMSG/PING/PONG/+OK/-ERR) and the embedded broker (parsing
+CONNECT/PUB/HPUB/SUB/UNSUB/PING/PONG), wire-compatible with the real NATS
+text protocol so external NATS tooling can interoperate.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Iterator
+
+CRLF = b"\r\n"
+
+# --- events -----------------------------------------------------------------
+
+
+@dataclass(slots=True)
+class MsgEvent:
+    """Server->client MSG/HMSG, or client->server PUB/HPUB (same shape)."""
+
+    op: str  # "MSG" | "HMSG" | "PUB" | "HPUB"
+    subject: str
+    sid: str | None  # subscription id (MSG/HMSG only)
+    reply: str | None
+    payload: bytes
+    headers: dict[str, str] | None = None
+
+
+@dataclass(slots=True)
+class SubEvent:
+    subject: str
+    queue: str | None
+    sid: str
+
+
+@dataclass(slots=True)
+class UnsubEvent:
+    sid: str
+    max_msgs: int | None
+
+
+@dataclass(slots=True)
+class CtrlEvent:
+    op: str  # "PING" | "PONG" | "OK"
+
+
+@dataclass(slots=True)
+class ErrEvent:
+    message: str
+
+
+@dataclass(slots=True)
+class InfoEvent:
+    info: dict
+
+
+@dataclass(slots=True)
+class ConnectEvent:
+    options: dict
+
+
+Event = MsgEvent | SubEvent | UnsubEvent | CtrlEvent | ErrEvent | InfoEvent | ConnectEvent
+
+
+# --- parser -----------------------------------------------------------------
+
+
+@dataclass
+class Parser:
+    """Incremental NATS protocol parser. Feed bytes, iterate events."""
+
+    _buf: bytearray = field(default_factory=bytearray)
+    # pending payload state: (event-to-complete, total_payload_len, header_len)
+    _pending: tuple[MsgEvent, int, int] | None = None
+
+    def feed(self, data: bytes) -> Iterator[Event]:
+        self._buf.extend(data)
+        while True:
+            if self._pending is not None:
+                ev, need, hdr_len = self._pending
+                if len(self._buf) < need + 2:  # payload + CRLF
+                    return
+                raw = bytes(self._buf[:need])
+                if self._buf[need : need + 2] != CRLF:
+                    raise ProtocolError("payload not terminated by CRLF")
+                del self._buf[: need + 2]
+                self._pending = None
+                if hdr_len:
+                    ev.headers = parse_headers(raw[:hdr_len])
+                    ev.payload = raw[hdr_len:]
+                else:
+                    ev.payload = raw
+                yield ev
+                continue
+
+            idx = self._buf.find(CRLF)
+            if idx < 0:
+                if len(self._buf) > 1 << 20:
+                    raise ProtocolError("control line too long")
+                return
+            line = bytes(self._buf[:idx])
+            del self._buf[: idx + 2]
+            ev = self._parse_line(line)
+            if ev is not None:
+                yield ev
+
+    def _parse_line(self, line: bytes) -> Event | None:
+        if not line:
+            return None
+        try:
+            text = line.decode()
+        except UnicodeDecodeError as e:
+            raise ProtocolError(f"bad control line: {line!r}") from e
+        op, _, rest = text.partition(" ")
+        opu = op.upper()
+        if opu in ("MSG", "PUB"):
+            self._msg_event(opu, rest.split(), with_headers=False)
+            return None
+        if opu in ("HMSG", "HPUB"):
+            self._msg_event(opu, rest.split(), with_headers=True)
+            return None
+        if opu == "PING":
+            return CtrlEvent("PING")
+        if opu == "PONG":
+            return CtrlEvent("PONG")
+        if opu == "+OK":
+            return CtrlEvent("OK")
+        if opu == "-ERR":
+            return ErrEvent(rest.strip().strip("'"))
+        if opu == "INFO":
+            return InfoEvent(json.loads(rest))
+        if opu == "CONNECT":
+            return ConnectEvent(json.loads(rest))
+        if opu == "SUB":
+            args = rest.split()
+            if len(args) == 2:
+                return SubEvent(args[0], None, args[1])
+            if len(args) == 3:
+                return SubEvent(args[0], args[1], args[2])
+            raise ProtocolError(f"bad SUB line: {text!r}")
+        if opu == "UNSUB":
+            args = rest.split()
+            if len(args) == 1:
+                return UnsubEvent(args[0], None)
+            if len(args) == 2:
+                return UnsubEvent(args[0], int(args[1]))
+            raise ProtocolError(f"bad UNSUB line: {text!r}")
+        raise ProtocolError(f"unknown protocol op: {op!r}")
+
+    def _msg_event(self, op: str, args: list[str], with_headers: bool) -> MsgEvent:
+        # MSG  <subject> <sid> [reply] <#bytes>
+        # PUB  <subject> [reply] <#bytes>
+        # HMSG <subject> <sid> [reply] <#hdr> <#total>
+        # HPUB <subject> [reply] <#hdr> <#total>
+        server_side = op in ("MSG", "HMSG")
+        n_fixed = (2 if server_side else 1) + (2 if with_headers else 1)
+        if len(args) == n_fixed:
+            reply = None
+        elif len(args) == n_fixed + 1:
+            reply = args[2 if server_side else 1]
+        else:
+            raise ProtocolError(f"bad {op} line: {args!r}")
+        subject = args[0]
+        sid = args[1] if server_side else None
+        if with_headers:
+            hdr_len = int(args[-2])
+            total = int(args[-1])
+        else:
+            hdr_len = 0
+            total = int(args[-1])
+        if total < hdr_len or total < 0:
+            raise ProtocolError(f"bad sizes in {op}: hdr={hdr_len} total={total}")
+        ev = MsgEvent(op=op, subject=subject, sid=sid, reply=reply, payload=b"")
+        # stash expected sizes for feed() loop
+        self._pending = (ev, total, hdr_len)
+        return ev
+
+
+class ProtocolError(Exception):
+    pass
+
+
+# --- headers ----------------------------------------------------------------
+
+HDR_PREAMBLE = b"NATS/1.0\r\n"
+
+
+def parse_headers(raw: bytes) -> dict[str, str]:
+    headers: dict[str, str] = {}
+    lines = raw.split(CRLF)
+    # first line is the version preamble, possibly with an inline status
+    # ("NATS/1.0 503"); keep status under a reserved key.
+    if lines and lines[0].startswith(b"NATS/1.0"):
+        status = lines[0][len(b"NATS/1.0") :].strip()
+        if status:
+            headers["Status"] = status.decode()
+        lines = lines[1:]
+    for line in lines:
+        if not line:
+            continue
+        k, _, v = line.partition(b":")
+        headers[k.decode().strip()] = v.decode().strip()
+    return headers
+
+
+def encode_headers(headers: dict[str, str]) -> bytes:
+    out = bytearray(HDR_PREAMBLE)
+    for k, v in headers.items():
+        out += f"{k}: {v}".encode() + CRLF
+    out += CRLF
+    return bytes(out)
+
+
+# --- serializers ------------------------------------------------------------
+
+
+def encode_pub(
+    subject: str, payload: bytes, reply: str | None = None, headers: dict[str, str] | None = None
+) -> bytes:
+    r = f" {reply}" if reply else ""
+    if headers:
+        h = encode_headers(headers)
+        head = f"HPUB {subject}{r} {len(h)} {len(h) + len(payload)}".encode()
+        return head + CRLF + h + payload + CRLF
+    head = f"PUB {subject}{r} {len(payload)}".encode()
+    return head + CRLF + payload + CRLF
+
+
+def encode_msg(
+    subject: str,
+    sid: str,
+    payload: bytes,
+    reply: str | None = None,
+    headers: dict[str, str] | None = None,
+) -> bytes:
+    r = f" {reply}" if reply else ""
+    if headers:
+        h = encode_headers(headers)
+        head = f"HMSG {subject} {sid}{r} {len(h)} {len(h) + len(payload)}".encode()
+        return head + CRLF + h + payload + CRLF
+    head = f"MSG {subject} {sid}{r} {len(payload)}".encode()
+    return head + CRLF + payload + CRLF
+
+
+def encode_sub(subject: str, sid: str, queue: str | None = None) -> bytes:
+    q = f" {queue}" if queue else ""
+    return f"SUB {subject}{q} {sid}".encode() + CRLF
+
+
+def encode_unsub(sid: str, max_msgs: int | None = None) -> bytes:
+    m = f" {max_msgs}" if max_msgs is not None else ""
+    return f"UNSUB {sid}{m}".encode() + CRLF
+
+
+def encode_connect(options: dict) -> bytes:
+    return b"CONNECT " + json.dumps(options, separators=(",", ":")).encode() + CRLF
+
+
+def encode_info(info: dict) -> bytes:
+    return b"INFO " + json.dumps(info, separators=(",", ":")).encode() + CRLF
+
+
+PING = b"PING" + CRLF
+PONG = b"PONG" + CRLF
+OK = b"+OK" + CRLF
+
+
+def encode_err(message: str) -> bytes:
+    return f"-ERR '{message}'".encode() + CRLF

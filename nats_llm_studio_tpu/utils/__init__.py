@@ -1,0 +1,4 @@
+from .nuid import next_nuid
+from .subjects import subject_matches, valid_subject
+
+__all__ = ["next_nuid", "subject_matches", "valid_subject"]

@@ -1,0 +1,52 @@
+"""NUID — fast unique identifiers for inboxes and upload ids.
+
+Mirrors the shape of NATS NUIDs (22 base-62 chars) so inbox subjects look like
+``_INBOX.<22 chars>.<seq>``, matching what nats.go clients generate (the
+reference's client example relies on ordinary request/reply inboxes,
+/root/reference/README.md:508-562).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_DIGITS = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz"
+_BASE = 62
+_PRE_LEN = 12
+_SEQ_LEN = 10
+_MAX_SEQ = _BASE**_SEQ_LEN
+
+
+class _Nuid:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._prefix = self._random_prefix()
+        self._seq = int.from_bytes(os.urandom(8), "big") % (_MAX_SEQ // 2)
+        self._inc = 100 + int.from_bytes(os.urandom(2), "big") % 300
+
+    @staticmethod
+    def _random_prefix() -> str:
+        raw = os.urandom(_PRE_LEN)
+        return "".join(_DIGITS[b % _BASE] for b in raw)
+
+    def next(self) -> str:
+        with self._lock:
+            self._seq += self._inc
+            if self._seq >= _MAX_SEQ:
+                self._prefix = self._random_prefix()
+                self._seq = int.from_bytes(os.urandom(8), "big") % (_MAX_SEQ // 2)
+            seq = self._seq
+        out = []
+        for _ in range(_SEQ_LEN):
+            seq, rem = divmod(seq, _BASE)
+            out.append(_DIGITS[rem])
+        return self._prefix + "".join(reversed(out))
+
+
+_global = _Nuid()
+
+
+def next_nuid() -> str:
+    """Return a process-unique 22-char identifier."""
+    return _global.next()

@@ -1,0 +1,37 @@
+"""NATS subject syntax: validation and wildcard matching.
+
+Implements the standard NATS rules the reference relies on implicitly through
+nats-server (subjects ``lmstudio.*`` — /root/reference/README.md:17-21):
+tokens separated by ``.``, ``*`` matches exactly one token, ``>`` matches one
+or more trailing tokens.
+"""
+
+from __future__ import annotations
+
+
+def valid_subject(subject: str, allow_wildcards: bool = False) -> bool:
+    if not subject or subject.startswith(".") or subject.endswith("."):
+        return False
+    for tok in subject.split("."):
+        if not tok:
+            return False
+        if any(c in tok for c in (" ", "\t", "\r", "\n")):
+            return False
+        if not allow_wildcards and tok in ("*", ">"):
+            return False
+    return True
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """True if a subscription ``pattern`` (may contain wildcards) matches ``subject``."""
+    ptoks = pattern.split(".")
+    stoks = subject.split(".")
+    i = 0
+    for i, ptok in enumerate(ptoks):
+        if ptok == ">":
+            return i < len(stoks)
+        if i >= len(stoks):
+            return False
+        if ptok != "*" and ptok != stoks[i]:
+            return False
+    return len(ptoks) == len(stoks)

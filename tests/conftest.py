@@ -1,0 +1,35 @@
+"""Test configuration.
+
+JAX runs on the CPU backend with 8 virtual devices so TP/EP/DP sharding logic
+is exercised multi-"device" on one host (SURVEY.md §4.3) — must be set before
+jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio
+import functools
+
+import pytest
+
+
+def async_test(fn):
+    """Run an async test via asyncio.run (no pytest-asyncio in this image)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60.0))
+
+    return wrapper
+
+
+@pytest.fixture
+def tmp_models_dir(tmp_path):
+    d = tmp_path / "models"
+    d.mkdir()
+    return d

@@ -1,0 +1,101 @@
+"""Fake engine + registry: the deterministic-echo backend SURVEY.md §4.2 calls
+for, substituted at the Registry seam (serve/api.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from nats_llm_studio_tpu.serve.api import ChatEngine, EngineError, ModelNotFound, Registry
+
+
+class EchoEngine(ChatEngine):
+    """Echoes the last user message back, one whitespace token at a time."""
+
+    def __init__(self, model_id: str, delay_s: float = 0.0):
+        self.model_id = model_id
+        self.delay_s = delay_s
+
+    def _reply_text(self, payload: dict) -> str:
+        msgs = payload.get("messages") or []
+        last_user = next((m["content"] for m in reversed(msgs) if m.get("role") == "user"), "")
+        return f"echo: {last_user}"
+
+    def _completion(self, payload: dict, text: str) -> dict:
+        n_prompt = sum(len(str(m.get("content", "")).split()) for m in payload.get("messages", []))
+        n_out = len(text.split())
+        return {
+            "id": f"chatcmpl-fake-{self.model_id}",
+            "object": "chat.completion",
+            "model": self.model_id,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_out,
+                "total_tokens": n_prompt + n_out,
+            },
+        }
+
+    async def chat(self, payload: dict) -> dict:
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
+        return self._completion(payload, self._reply_text(payload))
+
+    async def chat_stream(self, payload: dict):
+        text = self._reply_text(payload)
+        for i, word in enumerate(text.split()):
+            yield {
+                "object": "chat.completion.chunk",
+                "model": self.model_id,
+                "choices": [{"index": 0, "delta": {"content": word + " "}}],
+            }
+        yield self._completion(payload, text)
+
+    def info(self) -> dict:
+        return {
+            "id": self.model_id,
+            "object": "model",
+            "type": "llm",
+            "publisher": "fake",
+            "state": "loaded",
+        }
+
+
+class FakeRegistry(Registry):
+    def __init__(self, models: list[str] | None = None, delay_s: float = 0.0):
+        self.engines = {m: EchoEngine(m, delay_s) for m in (models or ["fake-echo-1"])}
+        self.pulled: list[str] = []
+        self.deleted: list[str] = []
+
+    async def list_models(self) -> dict:
+        return {"object": "list", "data": [e.info() for e in self.engines.values()]}
+
+    async def pull(self, identifier: str) -> str:
+        self.pulled.append(identifier)
+        self.engines[identifier] = EchoEngine(identifier)
+        return f"downloaded {identifier}"
+
+    async def delete(self, model_id: str) -> str:
+        if model_id not in self.engines:
+            e = EngineError(f"model directory not found: /fake/models/{model_id}")
+            e.dir = f"/fake/models/{model_id}"
+            raise e
+        del self.engines[model_id]
+        self.deleted.append(model_id)
+        return f"/fake/models/{model_id}"
+
+    async def get_engine(self, model_id: str) -> ChatEngine:
+        if model_id not in self.engines:
+            raise ModelNotFound(model_id)
+        return self.engines[model_id]
+
+    async def sync_from_bucket(self, name: str, model_id: str | None = None) -> str:
+        return f"/fake/models/{name}"
+
+    def stats(self) -> dict:
+        return {"models_loaded": sorted(self.engines)}

@@ -1,0 +1,227 @@
+"""Transport layer tests: protocol parser, broker routing, client API.
+
+Covers the NATS semantics the reference delegates to nats-server + nats.go:
+pub/sub, wildcard subjects, queue-group load balancing
+(/root/reference/README.md:478-484), request-reply, headers, streaming.
+"""
+
+import asyncio
+import collections
+
+import pytest
+
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+from nats_llm_studio_tpu.transport import protocol as p
+from nats_llm_studio_tpu.utils import subject_matches
+
+from conftest import async_test
+
+
+# --- pure protocol tests -----------------------------------------------------
+
+
+def test_subject_matching():
+    assert subject_matches("lmstudio.*", "lmstudio.chat_model")
+    assert not subject_matches("lmstudio.*", "lmstudio.a.b")
+    assert subject_matches("lmstudio.>", "lmstudio.a.b")
+    assert not subject_matches("lmstudio.>", "lmstudio")
+    assert subject_matches("a.*.c", "a.b.c")
+    assert subject_matches(">", "anything.at.all")
+    assert not subject_matches("a.b", "a.b.c")
+
+
+def test_parser_roundtrip_pub():
+    parser = p.Parser()
+    data = p.encode_pub("foo.bar", b"hello", reply="inbox.1")
+    events = list(parser.feed(data))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.op == "PUB" and ev.subject == "foo.bar"
+    assert ev.reply == "inbox.1" and ev.payload == b"hello"
+
+
+def test_parser_split_feeds():
+    parser = p.Parser()
+    data = p.encode_pub("s", b"x" * 1000) + p.PING + p.encode_pub("t", b"")
+    events = []
+    for i in range(0, len(data), 7):  # drip-feed 7 bytes at a time
+        events.extend(parser.feed(data[i : i + 7]))
+    assert [type(e).__name__ for e in events] == ["MsgEvent", "CtrlEvent", "MsgEvent"]
+    assert events[0].payload == b"x" * 1000
+    assert events[2].subject == "t" and events[2].payload == b""
+
+
+def test_parser_headers_roundtrip():
+    parser = p.Parser()
+    data = p.encode_pub("s", b"payload", headers={"Nats-Stream-Done": "1", "X-Seq": "42"})
+    (ev,) = parser.feed(data)
+    assert ev.op == "HPUB"
+    assert ev.headers == {"Nats-Stream-Done": "1", "X-Seq": "42"}
+    assert ev.payload == b"payload"
+
+
+def test_parser_binary_payload_with_crlf():
+    parser = p.Parser()
+    payload = b"a\r\nb\r\n\x00\xff" * 10
+    (ev,) = parser.feed(p.encode_pub("bin", payload))
+    assert ev.payload == payload
+
+
+# --- broker + client integration --------------------------------------------
+
+
+async def _broker():
+    return await EmbeddedBroker().start()
+
+
+@async_test
+async def test_pub_sub_roundtrip():
+    broker = await _broker()
+    try:
+        nc = await connect(broker.url)
+        sub = await nc.subscribe("greet.*")
+        await nc.flush()
+        await nc.publish("greet.world", b"hi", headers={"K": "V"})
+        msg = await sub.next_msg(timeout=5)
+        assert msg.subject == "greet.world"
+        assert msg.payload == b"hi"
+        assert msg.headers == {"K": "V"}
+        await nc.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_request_reply():
+    broker = await _broker()
+    try:
+        server = await connect(broker.url)
+
+        async def handler(msg):
+            await msg.respond(b"pong:" + msg.payload)
+
+        await server.subscribe("svc.echo", cb=handler)
+        await server.flush()
+
+        client = await connect(broker.url)
+        resp = await client.request("svc.echo", b"ping", timeout=5)
+        assert resp.payload == b"pong:ping"
+        await client.close()
+        await server.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_request_timeout():
+    broker = await _broker()
+    try:
+        client = await connect(broker.url)
+        with pytest.raises(asyncio.TimeoutError):
+            await client.request("nobody.home", b"", timeout=0.2)
+        await client.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_queue_group_load_balancing():
+    """Each message goes to exactly one member per queue group
+    (README.md:478-484); plain subscribers all get a copy."""
+    broker = await _broker()
+    try:
+        counts = collections.Counter()
+        workers = []
+        for i in range(3):
+            nc = await connect(broker.url)
+
+            async def handler(msg, i=i):
+                counts[i] += 1
+
+            await nc.subscribe("work.q", queue="workers", cb=handler)
+            await nc.flush()
+            workers.append(nc)
+
+        monitor = await connect(broker.url)
+        mon_sub = await monitor.subscribe("work.q")
+        await monitor.flush()
+
+        pub = await connect(broker.url)
+        N = 60
+        for _ in range(N):
+            await pub.publish("work.q", b"job")
+        await pub.flush()
+        await asyncio.sleep(0.2)
+
+        assert sum(counts.values()) == N  # one worker per message
+        assert all(c > 0 for c in counts.values())  # all members participate
+        got = 0
+        while got < N:  # monitor (non-queue) saw every message
+            await mon_sub.next_msg(timeout=2)
+            got += 1
+
+        for nc in workers + [monitor, pub]:
+            await nc.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_unsubscribe_stops_delivery():
+    broker = await _broker()
+    try:
+        nc = await connect(broker.url)
+        sub = await nc.subscribe("x")
+        await nc.flush()
+        await nc.publish("x", b"1")
+        assert (await sub.next_msg(timeout=5)).payload == b"1"
+        await sub.unsubscribe()
+        await nc.flush()
+        await nc.publish("x", b"2")
+        await nc.flush()
+        with pytest.raises((asyncio.TimeoutError, BrokenPipeError)):
+            await sub.next_msg(timeout=0.2)
+        await nc.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_large_payload():
+    broker = await _broker()
+    try:
+        nc = await connect(broker.url)
+        sub = await nc.subscribe("big")
+        await nc.flush()
+        blob = bytes(range(256)) * (4 * 1024 * 4)  # 4 MiB
+        await nc.publish("big", blob)
+        msg = await sub.next_msg(timeout=10)
+        assert msg.payload == blob
+        await nc.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_request_stream_terminal_header():
+    broker = await _broker()
+    try:
+        server = await connect(broker.url)
+
+        async def handler(msg):
+            for i in range(3):
+                await server.publish(msg.reply, f"chunk{i}".encode())
+            await server.publish(msg.reply, b"done", headers={"Nats-Stream-Done": "1"})
+
+        await server.subscribe("stream.svc", cb=handler)
+        await server.flush()
+
+        client = await connect(broker.url)
+        chunks = []
+        async for m in client.request_stream("stream.svc", b"", timeout=10):
+            chunks.append(m.payload)
+        assert chunks == [b"chunk0", b"chunk1", b"chunk2", b"done"]
+        await client.close()
+        await server.close()
+    finally:
+        await broker.stop()

@@ -1,0 +1,192 @@
+"""Worker handler-layer tests over real (embedded) NATS with a fake engine —
+the integration tier SURVEY.md §4.2 specifies. Exercises every validation
+branch of the reference handlers (nats_llm_studio.go:254-262, :293-300,
+:331-345), the envelope contract, queue-group scale-out with two workers, and
+token streaming."""
+
+import asyncio
+import collections
+import json
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+
+from conftest import async_test
+from fakes import FakeRegistry
+
+
+class Harness:
+    def __init__(self, n_workers=1, models=None, delay_s=0.0):
+        self.n_workers = n_workers
+        self.models = models
+        self.delay_s = delay_s
+
+    async def __aenter__(self):
+        self.broker = await EmbeddedBroker().start()
+        self.registries = []
+        self.workers = []
+        for _ in range(self.n_workers):
+            reg = FakeRegistry(models=self.models, delay_s=self.delay_s)
+            w = Worker(WorkerConfig(nats_url=self.broker.url), reg)
+            await w.start()
+            self.registries.append(reg)
+            self.workers.append(w)
+        self.nc = await connect(self.broker.url)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.nc.close()
+        for w in self.workers:
+            await w.drain()
+        await self.broker.stop()
+
+    async def req(self, op: str, payload, timeout=5.0):
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        msg = await self.nc.request(f"lmstudio.{op}", body, timeout=timeout)
+        return json.loads(msg.payload)
+
+
+@async_test
+async def test_list_models_envelope():
+    async with Harness(models=["m1", "m2"]) as h:
+        resp = await h.req("list_models", {})
+        assert resp["ok"] is True
+        assert "error" not in resp
+        assert resp["data"]["http_status"] == 200
+        ids = [m["id"] for m in resp["data"]["models"]["data"]]
+        assert sorted(ids) == ["m1", "m2"]
+        assert resp["data"]["models"]["object"] == "list"
+
+
+@async_test
+async def test_pull_model_validation_and_success():
+    async with Harness() as h:
+        resp = await h.req("pull_model", {})
+        assert resp["ok"] is False and resp["error"] == "'identifier' is required"
+
+        resp = await h.req("pull_model", b"{not json")
+        assert resp["ok"] is False and resp["error"].startswith("invalid JSON in PullModel")
+
+        resp = await h.req("pull_model", {"identifier": "pub/new-model"})
+        assert resp["ok"] is True
+        assert resp["data"]["model"] == "pub/new-model"
+        assert "output" in resp["data"]
+        assert h.registries[0].pulled == ["pub/new-model"]
+
+
+@async_test
+async def test_delete_model_validation_success_and_missing_dir():
+    async with Harness(models=["m1"]) as h:
+        resp = await h.req("delete_model", {})
+        assert resp["ok"] is False and resp["error"] == "'model_id' is required"
+
+        resp = await h.req("delete_model", {"model_id": "m1"})
+        assert resp["ok"] is True
+        assert resp["data"]["model"] == "m1"
+        assert resp["data"]["deleted_dir"].endswith("m1")
+
+        # missing model: error carries the attempted dir (go :304-313)
+        resp = await h.req("delete_model", {"model_id": "ghost"})
+        assert resp["ok"] is False
+        assert "model directory not found" in resp["error"]
+        assert resp["data"]["dir"].endswith("ghost")
+
+
+@async_test
+async def test_chat_model_validation_branches():
+    async with Harness() as h:
+        resp = await h.req("chat_model", b"")
+        assert resp["ok"] is False and "empty payload" in resp["error"]
+
+        resp = await h.req("chat_model", b"not json at all")
+        assert resp["ok"] is False and resp["error"].startswith("invalid JSON in ChatModel")
+
+        resp = await h.req("chat_model", {"messages": []})
+        assert resp["ok"] is False and resp["error"] == "'model' is required in ChatModel"
+
+        resp = await h.req("chat_model", {"model": "nope", "messages": []})
+        assert resp["ok"] is False and "model not found" in resp["error"]
+
+
+@async_test
+async def test_chat_model_success_shape():
+    async with Harness() as h:
+        payload = {
+            "model": "fake-echo-1",
+            "messages": [
+                {"role": "system", "content": "Always answer in rhymes."},
+                {"role": "user", "content": "hello tpu"},
+            ],
+        }
+        resp = await h.req("chat_model", payload)
+        assert resp["ok"] is True
+        data = resp["data"]
+        assert data["http_status"] == 200
+        response = data["response"]
+        assert response["object"] == "chat.completion"
+        assert response["choices"][0]["message"]["content"] == "echo: hello tpu"
+        assert response["usage"]["completion_tokens"] == 3
+        assert response["usage"]["total_tokens"] > 3
+
+
+@async_test
+async def test_chat_model_streaming():
+    async with Harness() as h:
+        payload = {
+            "model": "fake-echo-1",
+            "stream": True,
+            "messages": [{"role": "user", "content": "a b c"}],
+        }
+        chunks, final = [], None
+        async for m in h.nc.request_stream("lmstudio.chat_model", json.dumps(payload).encode(), timeout=10):
+            body = json.loads(m.payload)
+            if m.headers and "Nats-Stream-Done" in m.headers:
+                final = body
+            else:
+                chunks.append(body["data"]["chunk"])
+        assert final is not None and final["ok"] is True
+        text = "".join(c["choices"][0]["delta"]["content"] for c in chunks)
+        assert text.strip() == "echo: a b c"
+        assert final["data"]["response"]["choices"][0]["message"]["content"] == "echo: a b c"
+
+
+@async_test
+async def test_health_subject():
+    async with Harness(models=["m1"]) as h:
+        resp = await h.req("health", {})
+        assert resp["ok"] is True
+        assert resp["data"]["status"] == "ok"
+        assert resp["data"]["models_loaded"] == ["m1"]
+        assert resp["data"]["queue_group"] == "lmstudio-workers"
+
+
+@async_test
+async def test_sync_model_from_bucket_subject():
+    async with Harness() as h:
+        resp = await h.req("sync_model_from_bucket", {})
+        assert resp["ok"] is False and resp["error"] == "'object_name' is required"
+
+        resp = await h.req("sync_model_from_bucket", {"object_name": "pub/model/file.gguf"})
+        assert resp["ok"] is True
+        assert resp["data"]["local_path"].endswith("pub/model/file.gguf")
+
+
+@async_test
+async def test_two_workers_queue_group_scale_out():
+    """README.md:478-484: multiple workers under one queue group split load;
+    each request is answered exactly once."""
+    async with Harness(n_workers=2) as h:
+        N = 40
+        results = await asyncio.gather(
+            *[
+                h.req("chat_model", {"model": "fake-echo-1", "messages": [{"role": "user", "content": f"r{i}"}]})
+                for i in range(N)
+            ]
+        )
+        assert all(r["ok"] for r in results)
+        served = collections.Counter()
+        for i, w in enumerate(h.workers):
+            served[i] = w._requests_total
+        assert sum(served.values()) == N
+        assert all(v > 0 for v in served.values()), f"load not balanced: {served}"
