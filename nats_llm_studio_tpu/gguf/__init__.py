@@ -1,0 +1,32 @@
+"""GGUF model-file layer.
+
+The reference never parses GGUF itself — model files are opaque blobs managed
+by LM Studio under ``~/.lmstudio/models/<publisher>/<model>/``
+(/root/reference/nats_llm_studio.go:120, README.md:48-52) and all tensor work
+happens inside the external llama.cpp engine. Replacing that engine with an
+in-process TPU path requires a native GGUF v3 reader: metadata + tokenizer
+extraction, tensor index, and block dequantization (K-quants -> bf16/f32)
+feeding sharded device buffers.
+
+Everything here is implemented from the public GGUF/GGML format specification;
+no reference code exists for it.
+"""
+
+from .constants import GGMLType, GGUFValueType
+from .quants import dequantize, quantize, type_block_size, type_size
+from .reader import GGUFReader, GGUFTensor
+from .tokenizer import GGUFTokenizer
+from .writer import GGUFWriter
+
+__all__ = [
+    "GGMLType",
+    "GGUFValueType",
+    "GGUFReader",
+    "GGUFTensor",
+    "GGUFTokenizer",
+    "GGUFWriter",
+    "dequantize",
+    "quantize",
+    "type_block_size",
+    "type_size",
+]

@@ -1,0 +1,104 @@
+"""GGUF v3 wire-format constants (public GGML/GGUF specification)."""
+
+from __future__ import annotations
+
+import enum
+
+GGUF_MAGIC = 0x46554747  # b"GGUF" little-endian
+GGUF_VERSION = 3
+GGUF_DEFAULT_ALIGNMENT = 32
+
+# Standard metadata keys this framework reads/writes.
+KEY_ARCHITECTURE = "general.architecture"
+KEY_NAME = "general.name"
+KEY_ALIGNMENT = "general.alignment"
+KEY_QUANT_VERSION = "general.quantization_version"
+KEY_FILE_TYPE = "general.file_type"
+
+KEY_TOKENIZER_MODEL = "tokenizer.ggml.model"
+KEY_TOKENIZER_PRE = "tokenizer.ggml.pre"
+KEY_TOKENIZER_TOKENS = "tokenizer.ggml.tokens"
+KEY_TOKENIZER_SCORES = "tokenizer.ggml.scores"
+KEY_TOKENIZER_TYPES = "tokenizer.ggml.token_type"
+KEY_TOKENIZER_MERGES = "tokenizer.ggml.merges"
+KEY_TOKENIZER_BOS = "tokenizer.ggml.bos_token_id"
+KEY_TOKENIZER_EOS = "tokenizer.ggml.eos_token_id"
+KEY_TOKENIZER_ADD_BOS = "tokenizer.ggml.add_bos_token"
+KEY_TOKENIZER_ADD_EOS = "tokenizer.ggml.add_eos_token"
+KEY_CHAT_TEMPLATE = "tokenizer.chat_template"
+
+
+class GGUFValueType(enum.IntEnum):
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    UINT32 = 4
+    INT32 = 5
+    FLOAT32 = 6
+    BOOL = 7
+    STRING = 8
+    ARRAY = 9
+    UINT64 = 10
+    INT64 = 11
+    FLOAT64 = 12
+
+
+class GGMLType(enum.IntEnum):
+    """Tensor storage types (ggml type ids)."""
+
+    F32 = 0
+    F16 = 1
+    Q4_0 = 2
+    Q4_1 = 3
+    Q5_0 = 6
+    Q5_1 = 7
+    Q8_0 = 8
+    Q8_1 = 9
+    Q2_K = 10
+    Q3_K = 11
+    Q4_K = 12
+    Q5_K = 13
+    Q6_K = 14
+    Q8_K = 15
+    I8 = 24
+    I16 = 25
+    I32 = 26
+    I64 = 27
+    F64 = 28
+    BF16 = 30
+
+
+class TokenType(enum.IntEnum):
+    """tokenizer.ggml.token_type values."""
+
+    NORMAL = 1
+    UNKNOWN = 2
+    CONTROL = 3
+    USER_DEFINED = 4
+    UNUSED = 5
+    BYTE = 6
+
+
+# (elements per block, bytes per block) for each storage type.
+BLOCK_LAYOUT: dict[GGMLType, tuple[int, int]] = {
+    GGMLType.F32: (1, 4),
+    GGMLType.F16: (1, 2),
+    GGMLType.BF16: (1, 2),
+    GGMLType.F64: (1, 8),
+    GGMLType.I8: (1, 1),
+    GGMLType.I16: (1, 2),
+    GGMLType.I32: (1, 4),
+    GGMLType.I64: (1, 8),
+    GGMLType.Q4_0: (32, 18),
+    GGMLType.Q4_1: (32, 20),
+    GGMLType.Q5_0: (32, 22),
+    GGMLType.Q5_1: (32, 24),
+    GGMLType.Q8_0: (32, 34),
+    GGMLType.Q2_K: (256, 84),
+    GGMLType.Q3_K: (256, 110),
+    GGMLType.Q4_K: (256, 144),
+    GGMLType.Q5_K: (256, 176),
+    GGMLType.Q6_K: (256, 210),
+    GGMLType.Q8_K: (256, 292),
+}

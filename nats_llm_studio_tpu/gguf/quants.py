@@ -1,0 +1,422 @@
+"""Block (de)quantization for GGUF tensor storage types.
+
+Vectorized NumPy implementations of the public GGML block formats. Dequant is
+the load-path hot loop (GGUF blob -> bf16 shards on the TPU mesh); quantizers
+exist for fixture generation, checkpoint conversion, and roundtrip tests.
+Quantizers produce valid encodings with straightforward scale selection
+(per-(sub)block min/max or abs-max); they do not replicate llama.cpp's
+error-minimising search, which only affects quantisation quality, not format.
+
+The reference framework never touches these bytes — GGUF files are opaque to
+it (/root/reference/nats_llm_studio.go:120-131 manipulates them only as
+directories on disk).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .constants import BLOCK_LAYOUT, GGMLType
+
+_PLAIN_DTYPES: dict[GGMLType, np.dtype] = {
+    GGMLType.F32: np.dtype("<f4"),
+    GGMLType.F16: np.dtype("<f2"),
+    GGMLType.F64: np.dtype("<f8"),
+    GGMLType.I8: np.dtype("<i1"),
+    GGMLType.I16: np.dtype("<i2"),
+    GGMLType.I32: np.dtype("<i4"),
+    GGMLType.I64: np.dtype("<i8"),
+}
+
+
+def type_block_size(t: GGMLType) -> int:
+    """Elements per storage block."""
+    return BLOCK_LAYOUT[t][0]
+
+
+def type_size(t: GGMLType, n_elements: int) -> int:
+    """Bytes needed to store ``n_elements`` of type ``t``."""
+    block_elems, block_bytes = BLOCK_LAYOUT[t]
+    if n_elements % block_elems != 0:
+        raise ValueError(f"{n_elements} elements not divisible by {t.name} block of {block_elems}")
+    return n_elements // block_elems * block_bytes
+
+
+def _f16(raw: np.ndarray) -> np.ndarray:
+    """View 2-byte columns as little-endian float16 -> float32."""
+    return np.ascontiguousarray(raw).view("<f2").astype(np.float32)
+
+
+def _blocks(data: bytes | np.ndarray, t: GGMLType, n_elements: int) -> np.ndarray:
+    block_elems, block_bytes = BLOCK_LAYOUT[t]
+    n_blocks = n_elements // block_elems
+    arr = np.frombuffer(data, dtype=np.uint8, count=n_blocks * block_bytes)
+    return arr.reshape(n_blocks, block_bytes)
+
+
+# ---------------------------------------------------------------------------
+# dequantization
+# ---------------------------------------------------------------------------
+
+
+def dequantize(data: bytes | np.ndarray, t: GGMLType, n_elements: int) -> np.ndarray:
+    """Decode ``n_elements`` of storage type ``t`` to a flat float32 array
+    (plain integer types decode to their own dtype)."""
+    if t in _PLAIN_DTYPES:
+        dt = _PLAIN_DTYPES[t]
+        out = np.frombuffer(data, dtype=dt, count=n_elements)
+        return out.astype(np.float32) if dt.kind == "f" and dt.itemsize != 4 else np.asarray(out)
+    if t == GGMLType.BF16:
+        u16 = np.frombuffer(data, dtype="<u2", count=n_elements).astype(np.uint32)
+        return (u16 << 16).view(np.float32)
+    fn = _DEQUANT.get(t)
+    if fn is None:
+        raise NotImplementedError(f"dequantize: {t.name} not supported")
+    return fn(_blocks(data, t, n_elements)).reshape(-1)
+
+
+def _deq_q4_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])  # (N,1)->(N,) after view; keep 2-d via reshape
+    d = d.reshape(-1, 1)
+    qs = b[:, 2:18]
+    lo = (qs & 0x0F).astype(np.int8) - 8
+    hi = (qs >> 4).astype(np.int8) - 8
+    q = np.concatenate([lo, hi], axis=1).astype(np.float32)
+    return d * q
+
+
+def _deq_q4_1(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2]).reshape(-1, 1)
+    m = _f16(b[:, 2:4]).reshape(-1, 1)
+    qs = b[:, 4:20]
+    q = np.concatenate([qs & 0x0F, qs >> 4], axis=1).astype(np.float32)
+    return d * q + m
+
+
+def _deq_q5_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2]).reshape(-1, 1)
+    qh = b[:, 2:6].copy().view("<u4").reshape(-1, 1)  # (N,1) uint32
+    qs = b[:, 6:22]
+    j = np.arange(16)
+    hi_bit_lo = ((qh >> j) & 1).astype(np.uint8) << 4  # (N,16)
+    hi_bit_hi = ((qh >> (j + 16)) & 1).astype(np.uint8) << 4
+    x0 = ((qs & 0x0F) | hi_bit_lo).astype(np.int16) - 16
+    x1 = ((qs >> 4) | hi_bit_hi).astype(np.int16) - 16
+    return d * np.concatenate([x0, x1], axis=1).astype(np.float32)
+
+
+def _deq_q5_1(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2]).reshape(-1, 1)
+    m = _f16(b[:, 2:4]).reshape(-1, 1)
+    qh = b[:, 4:8].copy().view("<u4").reshape(-1, 1)
+    qs = b[:, 8:24]
+    j = np.arange(16)
+    hi_bit_lo = ((qh >> j) & 1).astype(np.uint8) << 4
+    hi_bit_hi = ((qh >> (j + 16)) & 1).astype(np.uint8) << 4
+    x0 = (qs & 0x0F) | hi_bit_lo
+    x1 = (qs >> 4) | hi_bit_hi
+    return d * np.concatenate([x0, x1], axis=1).astype(np.float32) + m
+
+
+def _deq_q8_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2]).reshape(-1, 1)
+    q = b[:, 2:34].view(np.int8).astype(np.float32)
+    return d * q
+
+
+def _deq_q8_k(b: np.ndarray) -> np.ndarray:
+    d = b[:, 0:4].copy().view("<f4").reshape(-1, 1)
+    q = b[:, 4:260].view(np.int8).astype(np.float32)
+    return d * q
+
+
+def _kquant_scales(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte packed 6-bit (scale, min) pairs of Q4_K/Q5_K.
+
+    Returns (sc, m), each (N, 8) uint8 in [0, 63].
+    """
+    s = scales.astype(np.uint8)
+    sc = np.empty(s.shape[:1] + (8,), dtype=np.uint8)
+    m = np.empty_like(sc)
+    sc[:, :4] = s[:, 0:4] & 63
+    m[:, :4] = s[:, 4:8] & 63
+    sc[:, 4:] = (s[:, 8:12] & 0x0F) | ((s[:, 0:4] >> 6) << 4)
+    m[:, 4:] = (s[:, 8:12] >> 4) | ((s[:, 4:8] >> 6) << 4)
+    return sc, m
+
+
+def _deq_q4_k(b: np.ndarray) -> np.ndarray:
+    n = b.shape[0]
+    d = _f16(b[:, 0:2]).reshape(n, 1, 1)
+    dmin = _f16(b[:, 2:4]).reshape(n, 1, 1)
+    sc, m = _kquant_scales(b[:, 4:16])
+    qs = b[:, 16:144].reshape(n, 4, 32)
+    lo = qs & 0x0F
+    hi = qs >> 4
+    # chunk c covers sub-blocks 2c (low nibbles) and 2c+1 (high nibbles)
+    q = np.stack([lo, hi], axis=2).reshape(n, 8, 32).astype(np.float32)
+    y = d * sc.astype(np.float32)[:, :, None] * q - dmin * m.astype(np.float32)[:, :, None]
+    return y.reshape(n, 256)
+
+
+def _deq_q5_k(b: np.ndarray) -> np.ndarray:
+    n = b.shape[0]
+    d = _f16(b[:, 0:2]).reshape(n, 1, 1)
+    dmin = _f16(b[:, 2:4]).reshape(n, 1, 1)
+    sc, m = _kquant_scales(b[:, 4:16])
+    qh = b[:, 16:48]  # (n, 32)
+    qs = b[:, 48:176].reshape(n, 4, 32)
+    shifts = (np.arange(8)).reshape(1, 8, 1)  # sub-block j uses qh bit j
+    hbit = ((qh[:, None, :] >> shifts) & 1).astype(np.uint8) << 4  # (n,8,32)
+    lo = qs & 0x0F
+    hi = qs >> 4
+    q4 = np.stack([lo, hi], axis=2).reshape(n, 8, 32)
+    q = (q4 | hbit).astype(np.float32)
+    y = d * sc.astype(np.float32)[:, :, None] * q - dmin * m.astype(np.float32)[:, :, None]
+    return y.reshape(n, 256)
+
+
+def _deq_q6_k(b: np.ndarray) -> np.ndarray:
+    n = b.shape[0]
+    ql = b[:, 0:128].reshape(n, 2, 2, 32)  # (half, byte-group, 32)
+    qh = b[:, 128:192].reshape(n, 2, 32)
+    scales = b[:, 192:208].view(np.int8).reshape(n, 2, 8)
+    d = _f16(b[:, 208:210]).reshape(n, 1, 1, 1)
+    parts = np.empty((n, 2, 4, 32), dtype=np.int16)
+    parts[:, :, 0] = (ql[:, :, 0] & 0x0F) | ((qh & 3) << 4)
+    parts[:, :, 1] = (ql[:, :, 1] & 0x0F) | (((qh >> 2) & 3) << 4)
+    parts[:, :, 2] = (ql[:, :, 0] >> 4) | (((qh >> 4) & 3) << 4)
+    parts[:, :, 3] = (ql[:, :, 1] >> 4) | (((qh >> 6) & 3) << 4)
+    q = parts.astype(np.float32) - 32.0
+    # scale index for part p, lane l within a half: (l // 16) + 2p
+    idx = (np.arange(32) // 16)[None, :] + 2 * np.arange(4)[:, None]  # (4, 32)
+    sc = scales.astype(np.float32)[:, :, idx]  # (n, 2, 4, 32)
+    return (d * sc * q).reshape(n, 256)
+
+
+_DEQUANT = {
+    GGMLType.Q4_0: _deq_q4_0,
+    GGMLType.Q4_1: _deq_q4_1,
+    GGMLType.Q5_0: _deq_q5_0,
+    GGMLType.Q5_1: _deq_q5_1,
+    GGMLType.Q8_0: _deq_q8_0,
+    GGMLType.Q8_K: _deq_q8_k,
+    GGMLType.Q4_K: _deq_q4_k,
+    GGMLType.Q5_K: _deq_q5_k,
+    GGMLType.Q6_K: _deq_q6_k,
+}
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+
+def quantize(x: np.ndarray, t: GGMLType) -> bytes:
+    """Encode a float array as storage type ``t``. Flattens row-major."""
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    if t in _PLAIN_DTYPES:
+        return np.ascontiguousarray(x.astype(_PLAIN_DTYPES[t])).tobytes()
+    if t == GGMLType.BF16:
+        u = x.view(np.uint32)
+        # round-to-nearest-even on the dropped 16 bits
+        rounded = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype("<u2")
+        return rounded.tobytes()
+    fn = _QUANT.get(t)
+    if fn is None:
+        raise NotImplementedError(f"quantize: {t.name} not supported")
+    block_elems, _ = BLOCK_LAYOUT[t]
+    if x.size % block_elems != 0:
+        raise ValueError(f"size {x.size} not divisible by {t.name} block of {block_elems}")
+    return fn(x.reshape(-1, block_elems)).tobytes()
+
+
+def _safe_div(num: np.ndarray, den: np.ndarray) -> np.ndarray:
+    return np.divide(num, den, out=np.zeros_like(num), where=den != 0)
+
+
+def _q_q8_0(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    amax = np.abs(x).max(axis=1, keepdims=True)
+    d = amax / 127.0
+    q = np.clip(np.rint(_safe_div(x, d)), -127, 127).astype(np.int8)
+    out = np.empty((n, 34), dtype=np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:34] = q.view(np.uint8)
+    return out
+
+
+def _signed_absmax(x: np.ndarray) -> np.ndarray:
+    """Per-row value with the largest magnitude, sign preserved. (N,1)"""
+    idx = np.abs(x).argmax(axis=1)
+    return x[np.arange(x.shape[0]), idx].reshape(-1, 1)
+
+
+def _q_q4_0(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    d = _signed_absmax(x) / -8.0
+    q = np.clip(np.rint(_safe_div(x, d)) + 8, 0, 15).astype(np.uint8)
+    out = np.empty((n, 18), dtype=np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:18] = q[:, :16] | (q[:, 16:] << 4)
+    return out
+
+
+def _q_q4_1(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    mn = x.min(axis=1, keepdims=True)
+    mx = x.max(axis=1, keepdims=True)
+    d = (mx - mn) / 15.0
+    q = np.clip(np.rint(_safe_div(x - mn, d)), 0, 15).astype(np.uint8)
+    out = np.empty((n, 20), dtype=np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:4] = mn.astype("<f2").view(np.uint8)
+    out[:, 4:20] = q[:, :16] | (q[:, 16:] << 4)
+    return out
+
+
+def _q_q5_0(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    d = _signed_absmax(x) / -16.0
+    q = np.clip(np.rint(_safe_div(x, d)) + 16, 0, 31).astype(np.uint32)
+    lo, hi = q[:, :16], q[:, 16:]
+    j = np.arange(16)
+    qh = ((lo >> 4 & 1) << j).sum(axis=1) | ((hi >> 4 & 1) << (j + 16)).sum(axis=1)
+    out = np.empty((n, 22), dtype=np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:6] = qh.astype("<u4").view(np.uint8).reshape(n, 4)
+    out[:, 6:22] = ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(np.uint8)
+    return out
+
+
+def _q_q5_1(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    mn = x.min(axis=1, keepdims=True)
+    mx = x.max(axis=1, keepdims=True)
+    d = (mx - mn) / 31.0
+    q = np.clip(np.rint(_safe_div(x - mn, d)), 0, 31).astype(np.uint32)
+    lo, hi = q[:, :16], q[:, 16:]
+    j = np.arange(16)
+    qh = ((lo >> 4 & 1) << j).sum(axis=1) | ((hi >> 4 & 1) << (j + 16)).sum(axis=1)
+    out = np.empty((n, 24), dtype=np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:4] = mn.astype("<f2").view(np.uint8)
+    out[:, 4:8] = qh.astype("<u4").view(np.uint8).reshape(n, 4)
+    out[:, 8:24] = ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(np.uint8)
+    return out
+
+
+def _q_q8_k(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    amax = np.abs(x).max(axis=1, keepdims=True)
+    d = amax / 127.0
+    q = np.clip(np.rint(_safe_div(x, d)), -127, 127).astype(np.int8)
+    bsums = q.reshape(n, 16, 16).sum(axis=2).astype("<i2")
+    out = np.empty((n, 292), dtype=np.uint8)
+    out[:, 0:4] = d.astype("<f4").view(np.uint8)
+    out[:, 4:260] = q.view(np.uint8)
+    out[:, 260:292] = bsums.view(np.uint8).reshape(n, 32)
+    return out
+
+
+def _pack_kquant_scales(sc: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """Pack 8 (scale, min) 6-bit pairs into the 12-byte Q4_K/Q5_K layout."""
+    n = sc.shape[0]
+    out = np.zeros((n, 12), dtype=np.uint8)
+    out[:, 0:4] = (sc[:, :4] & 63) | ((sc[:, 4:] >> 4) << 6)
+    out[:, 4:8] = (m[:, :4] & 63) | ((m[:, 4:] >> 4) << 6)
+    out[:, 8:12] = (sc[:, 4:] & 0x0F) | ((m[:, 4:] & 0x0F) << 4)
+    return out
+
+
+def _kquant_affine_params(x: np.ndarray, qmax: float) -> tuple[np.ndarray, ...]:
+    """Per-sub-block affine params for Q4_K/Q5_K: x ~ d*sc*q - dmin*m."""
+    sub = x.reshape(x.shape[0], 8, 32)
+    mn = sub.min(axis=2)
+    mx = sub.max(axis=2)
+    scales = (mx - mn) / qmax  # per-sub-block real scale, >= 0
+    mins = np.maximum(0.0, -mn)  # represented minimum is -dmin*m <= 0
+    d = scales.max(axis=1, keepdims=True) / 63.0
+    dmin = mins.max(axis=1, keepdims=True) / 63.0
+    sc = np.clip(np.rint(_safe_div(scales, d)), 0, 63).astype(np.uint8)
+    m = np.clip(np.rint(_safe_div(mins, dmin)), 0, 63).astype(np.uint8)
+    # quantize with the 6-bit-rounded params actually stored
+    d16 = d.astype("<f2")
+    dmin16 = dmin.astype("<f2")
+    eff_scale = d16.astype(np.float32) * sc  # (n, 8)
+    eff_min = dmin16.astype(np.float32) * m
+    q = np.clip(np.rint(_safe_div(sub + eff_min[:, :, None], eff_scale[:, :, None])), 0, qmax)
+    return d16, dmin16, sc, m, q.astype(np.uint8)
+
+
+def _q_q4_k(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    d16, dmin16, sc, m, q = _kquant_affine_params(x, 15.0)
+    out = np.empty((n, 144), dtype=np.uint8)
+    out[:, 0:2] = d16.view(np.uint8)
+    out[:, 2:4] = dmin16.view(np.uint8)
+    out[:, 4:16] = _pack_kquant_scales(sc, m)
+    pairs = q.reshape(n, 4, 2, 32)  # chunk c: sub 2c -> low nibble, 2c+1 -> high
+    out[:, 16:144] = (pairs[:, :, 0] | (pairs[:, :, 1] << 4)).reshape(n, 128)
+    return out
+
+
+def _q_q5_k(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    d16, dmin16, sc, m, q = _kquant_affine_params(x, 31.0)
+    out = np.empty((n, 176), dtype=np.uint8)
+    out[:, 0:2] = d16.view(np.uint8)
+    out[:, 2:4] = dmin16.view(np.uint8)
+    out[:, 4:16] = _pack_kquant_scales(sc, m)
+    hbits = (q >> 4) & 1  # (n, 8, 32)
+    shifts = np.arange(8).reshape(1, 8, 1)
+    out[:, 16:48] = (hbits.astype(np.uint8) << shifts).sum(axis=1, dtype=np.uint8)
+    low4 = (q & 0x0F).reshape(n, 4, 2, 32)
+    out[:, 48:176] = (low4[:, :, 0] | (low4[:, :, 1] << 4)).reshape(n, 128)
+    return out
+
+
+def _q_q6_k(x: np.ndarray) -> np.ndarray:
+    n = x.shape[0]
+    sub = x.reshape(n, 16, 16)
+    amax = np.abs(sub).max(axis=2)
+    a = amax / 31.0  # per-sub-block effective scale
+    d = a.max(axis=1, keepdims=True) / 127.0
+    d16 = d.astype("<f2")
+    sc = np.clip(np.rint(_safe_div(a, d16.astype(np.float32))), -128, 127).astype(np.int8)
+    eff = d16.astype(np.float32) * sc  # (n, 16)
+    q = np.clip(np.rint(_safe_div(sub, eff[:, :, None])) + 32, 0, 63).astype(np.uint8)
+    # scatter into the (half, part, lane) layout used by dequant
+    q = q.reshape(n, 16, 16)
+    y = np.empty((n, 2, 4, 32), dtype=np.uint8)  # part p holds elems [p*32, p*32+32) of a half
+    for h in range(2):
+        half = q[:, 8 * h : 8 * h + 8].reshape(n, 128)
+        y[:, h] = half.reshape(n, 4, 32)
+    ql = np.empty((n, 2, 2, 32), dtype=np.uint8)
+    ql[:, :, 0] = (y[:, :, 0] & 0x0F) | ((y[:, :, 2] & 0x0F) << 4)
+    ql[:, :, 1] = (y[:, :, 1] & 0x0F) | ((y[:, :, 3] & 0x0F) << 4)
+    qh = (
+        (y[:, :, 0] >> 4)
+        | ((y[:, :, 1] >> 4) << 2)
+        | ((y[:, :, 2] >> 4) << 4)
+        | ((y[:, :, 3] >> 4) << 6)
+    )
+    out = np.empty((n, 210), dtype=np.uint8)
+    out[:, 0:128] = ql.reshape(n, 128)
+    out[:, 128:192] = qh.reshape(n, 64)
+    out[:, 192:208] = sc.view(np.uint8)
+    out[:, 208:210] = d16.view(np.uint8)
+    return out
+
+
+_QUANT = {
+    GGMLType.Q8_0: _q_q8_0,
+    GGMLType.Q4_0: _q_q4_0,
+    GGMLType.Q4_1: _q_q4_1,
+    GGMLType.Q5_0: _q_q5_0,
+    GGMLType.Q5_1: _q_q5_1,
+    GGMLType.Q8_K: _q_q8_k,
+    GGMLType.Q4_K: _q_q4_k,
+    GGMLType.Q5_K: _q_q5_k,
+    GGMLType.Q6_K: _q_q6_k,
+}

@@ -1,0 +1,205 @@
+"""mmap-backed GGUF v3 reader.
+
+Parses the header, metadata KV section, and tensor index; tensor bytes stay on
+disk (memory-mapped) until a caller dequantizes them, so a 40 GB 70B file can
+be loaded shard-by-shard onto the device mesh without materialising the whole
+model in host RAM (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, BinaryIO
+
+import numpy as np
+
+from .constants import (
+    GGUF_DEFAULT_ALIGNMENT,
+    GGUF_MAGIC,
+    KEY_ALIGNMENT,
+    GGMLType,
+    GGUFValueType,
+)
+from .quants import dequantize, type_size
+
+_SCALAR_FMT = {
+    GGUFValueType.UINT8: "<B",
+    GGUFValueType.INT8: "<b",
+    GGUFValueType.UINT16: "<H",
+    GGUFValueType.INT16: "<h",
+    GGUFValueType.UINT32: "<I",
+    GGUFValueType.INT32: "<i",
+    GGUFValueType.FLOAT32: "<f",
+    GGUFValueType.UINT64: "<Q",
+    GGUFValueType.INT64: "<q",
+    GGUFValueType.FLOAT64: "<d",
+}
+
+
+class GGUFFormatError(ValueError):
+    pass
+
+
+@dataclass
+class GGUFTensor:
+    """One entry of the tensor index.
+
+    ``shape`` is in logical (row-major, numpy) order — GGUF stores dims
+    reversed (ne[0] is the fastest-varying / contiguous axis), and this reader
+    undoes that so ``shape == dequantized.shape``.
+    """
+
+    name: str
+    shape: tuple[int, ...]
+    ggml_type: GGMLType
+    offset: int  # absolute file offset of the first byte
+    _buf: memoryview
+
+    @property
+    def n_elements(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def n_bytes(self) -> int:
+        return type_size(self.ggml_type, self.n_elements)
+
+    def raw(self) -> memoryview:
+        return self._buf[self.offset : self.offset + self.n_bytes]
+
+    def to_numpy(self, dtype: np.dtype | str | None = None) -> np.ndarray:
+        """Dequantize to a dense array of ``self.shape``."""
+        arr = dequantize(np.frombuffer(self.raw(), dtype=np.uint8), self.ggml_type, self.n_elements)
+        arr = arr.reshape(self.shape)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+class _Cursor:
+    def __init__(self, buf: memoryview):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> memoryview:
+        if self.pos + n > len(self.buf):
+            raise GGUFFormatError("truncated GGUF file")
+        out = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def scalar(self, fmt: str) -> Any:
+        size = struct.calcsize(fmt)
+        return struct.unpack(fmt, self.take(size))[0]
+
+    def string(self) -> str:
+        n = self.scalar("<Q")
+        if n > len(self.buf):
+            raise GGUFFormatError("string length exceeds file size")
+        return bytes(self.take(n)).decode("utf-8", errors="replace")
+
+    def value(self, vtype: GGUFValueType) -> Any:
+        if vtype == GGUFValueType.BOOL:
+            return bool(self.scalar("<B"))
+        if vtype == GGUFValueType.STRING:
+            return self.string()
+        if vtype == GGUFValueType.ARRAY:
+            etype = GGUFValueType(self.scalar("<I"))
+            count = self.scalar("<Q")
+            if etype in _SCALAR_FMT and etype != GGUFValueType.BOOL:
+                fmt = _SCALAR_FMT[etype]
+                size = struct.calcsize(fmt)
+                raw = self.take(count * size)
+                return np.frombuffer(raw, dtype=fmt).tolist()
+            return [self.value(etype) for _ in range(count)]
+        fmt = _SCALAR_FMT.get(vtype)
+        if fmt is None:
+            raise GGUFFormatError(f"unknown metadata value type {vtype}")
+        return self.scalar(fmt)
+
+
+class GGUFReader:
+    """Read-only view over a GGUF file: ``.metadata`` dict + ``.tensors``."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._file: BinaryIO = open(self.path, "rb")
+        try:
+            self._mmap = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+            buf = memoryview(self._mmap)
+        except (ValueError, OSError):  # empty file or fs without mmap
+            self._mmap = None
+            buf = memoryview(self._file.read())
+        self._buf = buf
+        self.metadata: dict[str, Any] = {}
+        self.tensors: dict[str, GGUFTensor] = {}
+        self._parse()
+
+    def close(self) -> None:
+        self._buf.release()
+        if self._mmap is not None:
+            self._mmap.close()
+        self._file.close()
+
+    def __enter__(self) -> "GGUFReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _parse(self) -> None:
+        cur = _Cursor(self._buf)
+        magic = cur.scalar("<I")
+        if magic != GGUF_MAGIC:
+            raise GGUFFormatError(f"bad magic {magic:#x} (not a GGUF file)")
+        version = cur.scalar("<I")
+        if version not in (2, 3):
+            raise GGUFFormatError(f"unsupported GGUF version {version}")
+        self.version = version
+        n_tensors = cur.scalar("<Q")
+        n_kv = cur.scalar("<Q")
+        for _ in range(n_kv):
+            key = cur.string()
+            vtype = GGUFValueType(cur.scalar("<I"))
+            self.metadata[key] = cur.value(vtype)
+
+        infos: list[tuple[str, tuple[int, ...], GGMLType, int]] = []
+        for _ in range(n_tensors):
+            name = cur.string()
+            n_dims = cur.scalar("<I")
+            dims = [cur.scalar("<Q") for _ in range(n_dims)]
+            ttype = GGMLType(cur.scalar("<I"))
+            rel_offset = cur.scalar("<Q")
+            # GGUF dims are reversed relative to row-major logical shape
+            infos.append((name, tuple(reversed(dims)), ttype, rel_offset))
+
+        try:
+            alignment = int(self.metadata.get(KEY_ALIGNMENT, GGUF_DEFAULT_ALIGNMENT))
+        except (TypeError, ValueError) as e:
+            raise GGUFFormatError(f"bad general.alignment: {e}") from None
+        if alignment <= 0:
+            raise GGUFFormatError(f"bad general.alignment: {alignment}")
+        data_start = (cur.pos + alignment - 1) // alignment * alignment
+        for name, shape, ttype, rel in infos:
+            self.tensors[name] = GGUFTensor(
+                name=name, shape=shape, ggml_type=ttype, offset=data_start + rel, _buf=self._buf
+            )
+
+    # convenience -----------------------------------------------------------
+
+    def tensor(self, name: str) -> GGUFTensor:
+        try:
+            return self.tensors[name]
+        except KeyError:
+            raise KeyError(f"tensor {name!r} not in {self.path.name}") from None
+
+    @property
+    def architecture(self) -> str:
+        return str(self.metadata.get("general.architecture", ""))
+
+    def arch_field(self, field: str, default: Any = None) -> Any:
+        """Read ``<architecture>.<field>`` from metadata."""
+        return self.metadata.get(f"{self.architecture}.{field}", default)

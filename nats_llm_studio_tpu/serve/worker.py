@@ -65,7 +65,7 @@ class Worker:
             cfg.subject("health"): self.on_health,
         }
         for subject, handler in subs.items():
-            await self.nc.subscribe(subject, queue=q, cb=handler)
+            await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
         await self.nc.flush()
         self._started.set()
         log.info("worker serving %s.* (queue=%s)", cfg.subject_prefix, q)
@@ -81,6 +81,20 @@ class Worker:
     async def drain(self) -> None:
         if self.nc is not None:
             await self.nc.drain()
+
+    def _guarded(self, handler):
+        """Last-resort catch-all: the Go reference replies with an error
+        envelope on every failure path; an exception escaping a handler must
+        not leave the requester waiting out its timeout."""
+
+        async def run(msg: Msg) -> None:
+            try:
+                await handler(msg)
+            except Exception as e:  # noqa: BLE001 — deliberate catch-all seam
+                log.exception("handler for %s failed", msg.subject)
+                await self._respond_error(msg, f"internal error: {e}")
+
+        return run
 
     # -- envelope helpers ----------------------------------------------------
 
@@ -194,10 +208,13 @@ class Worker:
         if not model_id:
             await self._respond_error(msg, "'model' is required in ChatModel")
             return
+        if payload.get("stream") and not msg.reply:
+            return  # fire-and-forget stream request: nowhere to send tokens
+        streaming = bool(payload.get("stream"))
         try:
             async with asyncio.timeout(self.config.chat_timeout_s):
                 engine = await self.registry.get_engine(model_id)
-                if payload.get("stream"):
+                if streaming:
                     await self._chat_streaming(msg, engine, payload)
                 else:
                     response = await engine.chat(payload)
@@ -205,11 +222,30 @@ class Worker:
                     self._tokens_total += usage.get("completion_tokens", 0)
                     await self._respond_ok(msg, {"http_status": 200, "response": response})
         except asyncio.TimeoutError:
-            await self._respond_error(msg, "error in chat: deadline exceeded", {"model": model_id})
+            await self._error_terminal(
+                msg, "error in chat: deadline exceeded", {"model": model_id}, streaming
+            )
         except ModelNotFound as e:
-            await self._respond_error(msg, f"model not found: {e}", {"model": model_id})
+            await self._error_terminal(msg, f"model not found: {e}", {"model": model_id}, streaming)
         except EngineError as e:
-            await self._respond_error(msg, f"error in chat: {e}", {"model": model_id})
+            await self._error_terminal(msg, f"error in chat: {e}", {"model": model_id}, streaming)
+        except Exception as e:  # noqa: BLE001 — mid-stream crash must still terminate the stream
+            log.exception("chat handler failed for %s", model_id)
+            await self._error_terminal(msg, f"internal error: {e}", {"model": model_id}, streaming)
+
+    async def _error_terminal(self, msg: Msg, error: str, data, streaming: bool) -> None:
+        """Error reply that, mid-stream, still carries the terminal
+        ``Nats-Stream-Done`` header so ``request_stream`` consumers end
+        cleanly instead of waiting out their idle timeout."""
+        if streaming and self.nc is not None and msg.reply:
+            try:
+                await self.nc.publish(
+                    msg.reply, envelope_error(error, data), headers={"Nats-Stream-Done": "1"}
+                )
+            except (ConnectionError, ValueError):
+                log.warning("failed to publish terminal error on %s", msg.reply)
+        else:
+            await self._respond_error(msg, error, data)
 
     async def _chat_streaming(self, msg: Msg, engine, payload: dict) -> None:
         assert self.nc is not None

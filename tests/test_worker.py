@@ -190,3 +190,28 @@ async def test_two_workers_queue_group_scale_out():
             served[i] = w._requests_total
         assert sum(served.values()) == N
         assert all(v > 0 for v in served.values()), f"load not balanced: {served}"
+
+
+@async_test
+async def test_unexpected_exception_still_replies_error_envelope():
+    """An exception escaping a handler (not EngineError) must produce an
+    error envelope, not leave the requester to time out — the reference
+    replies on every failure path (nats_llm_studio.go:207-226)."""
+
+    class ExplodingRegistry(FakeRegistry):
+        async def list_models(self):
+            raise RuntimeError("boom")
+
+    broker = await EmbeddedBroker().start()
+    try:
+        w = Worker(WorkerConfig(nats_url=broker.url), ExplodingRegistry())
+        await w.start()
+        nc = await connect(broker.url)
+        msg = await nc.request("lmstudio.list_models", b"{}", timeout=5.0)
+        resp = json.loads(msg.payload)
+        assert resp["ok"] is False
+        assert "internal error" in resp["error"] and "boom" in resp["error"]
+        await nc.close()
+        await w.drain()
+    finally:
+        await broker.stop()
