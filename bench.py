@@ -1,0 +1,140 @@
+"""Benchmark: batched decode throughput + prefill TTFT on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Model: flagship granite-3.0-2b geometry (BASELINE.md config 1) with random
+bf16 weights — throughput depends on shapes/dtypes, not weight values.
+Baseline reference: the north-star 2000 tok/s/chip (BASELINE.md config 2).
+Runs on the ambient JAX platform (real TPU under the driver; set
+JAX_PLATFORMS=cpu BENCH_TINY=1 for a smoke run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(x) -> None:
+    """Force completion: block_until_ready alone does not flush execution on
+    every remote-device transport, a device->host copy does."""
+    jax.block_until_ready(x)
+    np.asarray(x)
+
+from nats_llm_studio_tpu.engine.sampling import sample
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+
+NORTH_STAR_TOK_S = 2000.0
+
+
+def main() -> None:
+    tiny = bool(os.environ.get("BENCH_TINY"))
+    if tiny:
+        cfg = ModelConfig.tiny()
+        batch, prompt_len, seq_len, steps = 2, 16, 64, 8
+    else:
+        from __graft_entry__ import GRANITE_2B
+
+        cfg = GRANITE_2B
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+        seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+        steps = int(os.environ.get("BENCH_STEPS", "128"))
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    fwd = partial(forward, cfg=cfg)
+
+    @jax.jit
+    def prefill(params, tokens, k, v, start):
+        logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start)
+        return sample(logits[:, -1, :], jax.random.PRNGKey(1), temperature=0.0), k, v
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def decode(params, tok, k, v, pos):
+        logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v, start_pos=pos)
+        return sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0), k, v
+
+    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4,))
+    def decode_n(params, tok, k, v, n, pos0):
+        """n decode steps as one device-side scan: measures chip throughput
+        without per-step host dispatch (the remote-device tunnel costs ~ms per
+        call, which would swamp a ~6 ms memory-bound step)."""
+
+        def body(carry, i):
+            tok, k, v = carry
+            logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v,
+                               start_pos=pos0 + i)
+            nxt = sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0)
+            return (nxt, k, v), nxt
+
+        (tok, k, v), toks = jax.lax.scan(body, (tok, k, v), jnp.arange(n, dtype=jnp.int32))
+        return tok, k, v, toks
+
+    k, v = make_cache(cfg, batch, seq_len)
+    tokens = jnp.ones((batch, prompt_len), jnp.int32)
+    start = jnp.zeros((batch,), jnp.int32)
+
+    # compile both programs
+    tok, k, v = prefill(params, tokens, k, v, start)
+    pos = jnp.full((batch,), prompt_len, jnp.int32)
+    tok, k, v = decode(params, tok, k, v, pos)
+    _sync(tok)
+
+    # prefill latency (compiled)
+    k2, v2 = make_cache(cfg, batch, seq_len)
+    t0 = time.perf_counter()
+    tok2, k2, v2 = prefill(params, tokens, k2, v2, start)
+    _sync(tok2)
+    prefill_s = time.perf_counter() - t0
+    del k2, v2
+
+    # host-driven decode loop (includes per-step dispatch overhead)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        pos = jnp.full((batch,), prompt_len + 1 + i, jnp.int32)
+        tok, k, v = decode(params, tok, k, v, pos)
+    _sync(tok)
+    host_dt = time.perf_counter() - t0
+    host_tok_s = batch * steps / host_dt
+
+    # device-side scan loop (chip throughput) — compile, then time a fresh run
+    pos0 = jnp.full((batch,), prompt_len + 1 + steps, jnp.int32)
+    tok, k, v, _ = decode_n(params, tok, k, v, steps, pos0)
+    _sync(tok)
+    pos0 = pos0 + steps
+    t0 = time.perf_counter()
+    tok, k, v, toks = decode_n(params, tok, k, v, steps, pos0)
+    _sync(toks)
+    dt = time.perf_counter() - t0
+    tok_s = batch * steps / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "granite2b_bf16_decode_tok_s" + (".tiny" if tiny else f".b{batch}"),
+                "value": round(tok_s, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 3),
+                "detail": {
+                    "batch": batch,
+                    "prompt_len": prompt_len,
+                    "decode_steps": steps,
+                    "prefill_s": round(prefill_s, 4),
+                    "host_loop_tok_s": round(host_tok_s, 1),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

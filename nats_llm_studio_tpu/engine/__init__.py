@@ -1,0 +1,11 @@
+"""Inference engine: jitted prefill/decode, sampling, generation loop.
+
+Replaces the reference's external llama.cpp hot loop
+(/root/reference/README.md:6, SURVEY.md §3.1: "THE hot loop, entirely outside
+the repo") with an in-process JAX decode loop on TPU.
+"""
+
+from .generator import Generator
+from .sampling import sample
+
+__all__ = ["Generator", "sample"]

@@ -1,0 +1,179 @@
+"""Autoregressive generation driver around the jitted forward pass.
+
+Design for TTFT (SURVEY.md §7 hard part #1): prompt lengths are padded to a
+small set of bucket shapes so XLA compiles a handful of prefill programs
+instead of one per length; ``warmup()`` pre-compiles them ahead of traffic.
+Decode is a single fused jit step (forward + sample) whose only host traffic
+is the sampled token id.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.llama import forward, make_cache
+from .sampling import sample
+
+
+def default_buckets(max_seq: int, start: int = 32) -> list[int]:
+    """Powers of two from ``start`` up to max_seq (always includes max_seq)."""
+    out = []
+    b = start
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return out
+
+
+@dataclass
+class GenStats:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    ttft_s: float = 0.0
+    total_s: float = 0.0
+
+    @property
+    def decode_tok_s(self) -> float:
+        decode_time = self.total_s - self.ttft_s
+        n = max(self.completion_tokens - 1, 0)
+        return n / decode_time if decode_time > 0 else 0.0
+
+
+@dataclass
+class SamplingParams:
+    temperature: float = 0.8
+    top_k: int = 0
+    top_p: float = 1.0
+    max_tokens: int = 256
+    seed: int | None = None
+    stop_ids: frozenset[int] = field(default_factory=frozenset)
+
+
+class Generator:
+    """Owns jitted prefill/decode for one loaded model.
+
+    Single-stream ``generate()`` here; the continuous batcher in serve/ drives
+    the same ``decode_step`` at a fixed batch width.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: ModelConfig,
+        max_seq_len: int | None = None,
+        buckets: list[int] | None = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_seq = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+        self.buckets = buckets or default_buckets(self.max_seq)
+
+        fwd = partial(forward, cfg=cfg)
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def prefill_fn(params, tokens, k_cache, v_cache, start_pos):
+            logits, k_cache, v_cache = fwd(params, tokens=tokens, k_cache=k_cache,
+                                           v_cache=v_cache, start_pos=start_pos)
+            return logits, k_cache, v_cache
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def decode_fn(params, token, k_cache, v_cache, pos, key, temperature, top_k, top_p):
+            logits, k_cache, v_cache = fwd(params, tokens=token, k_cache=k_cache,
+                                           v_cache=v_cache, start_pos=pos)
+            next_tok = sample(logits[:, -1, :], key, temperature, top_k, top_p)
+            return next_tok, k_cache, v_cache
+
+        self._prefill = prefill_fn
+        self._decode = decode_fn
+
+    # -- shape management ----------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds max_seq_len {self.max_seq}")
+
+    def warmup(self, batch: int = 1, buckets: list[int] | None = None) -> float:
+        """AOT-compile prefill buckets + the decode step. Returns seconds."""
+        t0 = time.perf_counter()
+        for b in buckets or self.buckets:
+            k, v = make_cache(self.cfg, batch, self.max_seq)
+            tokens = jnp.zeros((batch, b), jnp.int32)
+            logits, k, v = self._prefill(self.params, tokens, k, v, jnp.zeros((batch,), jnp.int32))
+            tok = jnp.zeros((batch, 1), jnp.int32)
+            self._decode(
+                self.params, tok, k, v,
+                jnp.full((batch,), b, jnp.int32), jax.random.PRNGKey(0),
+                jnp.ones((batch,)), jnp.zeros((batch,), jnp.int32), jnp.ones((batch,)),
+            )
+        jax.block_until_ready(logits)
+        return time.perf_counter() - t0
+
+    # -- generation ----------------------------------------------------------
+
+    def generate(
+        self, prompt_ids: list[int], sp: SamplingParams | None = None
+    ) -> Iterator[tuple[int, GenStats]]:
+        """Yield (token_id, running_stats) until a stop id or max_tokens.
+
+        The final yielded stats carry total timing; ttft is measured at the
+        first yielded token.
+        """
+        sp = sp or SamplingParams()
+        n = len(prompt_ids)
+        if n == 0:
+            return
+        if n >= self.max_seq:
+            raise ValueError(f"prompt of {n} tokens >= max_seq_len {self.max_seq}")
+        bucket = self.bucket_for(n)
+        stats = GenStats(prompt_tokens=n)
+        t_start = time.perf_counter()
+
+        tokens = jnp.asarray([prompt_ids + [0] * (bucket - n)], jnp.int32)
+        k_cache, v_cache = make_cache(self.cfg, 1, self.max_seq)
+        logits, k_cache, v_cache = self._prefill(
+            self.params, tokens, k_cache, v_cache, jnp.zeros((1,), jnp.int32)
+        )
+        key = jax.random.PRNGKey(sp.seed if sp.seed is not None else time.monotonic_ns() % 2**31)
+        key, sub = jax.random.split(key)
+        temp = jnp.full((1,), sp.temperature, jnp.float32)
+        tk = jnp.full((1,), sp.top_k, jnp.int32)
+        tp = jnp.full((1,), sp.top_p, jnp.float32)
+        next_tok = sample(logits[:, n - 1, :], sub, temp, tk, tp)
+
+        pos = n
+        max_new = min(sp.max_tokens, self.max_seq - n)
+        for i in range(max_new):
+            tok_id = int(next_tok[0])
+            if i == 0:
+                stats.ttft_s = time.perf_counter() - t_start
+            if tok_id in sp.stop_ids:
+                break
+            stats.completion_tokens += 1
+            stats.total_s = time.perf_counter() - t_start
+            yield tok_id, stats
+            if i == max_new - 1:
+                break
+            key, sub = jax.random.split(key)
+            next_tok, k_cache, v_cache = self._decode(
+                self.params,
+                next_tok[:, None],
+                k_cache,
+                v_cache,
+                jnp.full((1,), pos, jnp.int32),
+                sub,
+                temp,
+                tk,
+                tp,
+            )
+            pos += 1
+        stats.total_s = time.perf_counter() - t_start

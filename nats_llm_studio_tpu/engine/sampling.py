@@ -1,0 +1,46 @@
+"""Token sampling: temperature / top-k / top-p, fully vectorized per row.
+
+Per-request parameters are arrays of shape [B] so one jitted decode step can
+serve a continuously-batched set of requests with different sampling settings
+(SURVEY.md §7: the batcher is on the critical perf path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    temperature: jax.Array | float = 0.8,
+    top_k: jax.Array | int = 0,  # 0 = disabled
+    top_p: jax.Array | float = 1.0,
+) -> jax.Array:
+    """Returns sampled token ids [B] int32. temperature <= 0 means greedy
+    (per row). One sort of the vocab per call; masks are rank-based so top-k
+    and top-p are per-row arrays, not static."""
+    b, v = logits.shape
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # desc
+    sorted_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    ranks = jnp.arange(v)[None, :]
+
+    k_eff = jnp.where(top_k <= 0, v, top_k)[:, None]
+    keep = ranks < k_eff
+
+    # top-p over the sorted softmax; always keep the first token that crosses p
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(sorted_logits / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_p[:, None]
+
+    masked = jnp.where(keep, sorted_logits, -jnp.inf)
+    drawn = jax.random.categorical(key, masked / safe_t, axis=-1)  # index into sorted order
+    sampled = jnp.take_along_axis(sorted_idx, drawn[:, None], axis=-1)[:, 0]
+    greedy = sorted_idx[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -44,6 +44,21 @@ class GGUFValueType(enum.IntEnum):
     FLOAT64 = 12
 
 
+# struct format per scalar metadata value type (wire encoding, little-endian)
+SCALAR_FMT: dict[GGUFValueType, str] = {
+    GGUFValueType.UINT8: "<B",
+    GGUFValueType.INT8: "<b",
+    GGUFValueType.UINT16: "<H",
+    GGUFValueType.INT16: "<h",
+    GGUFValueType.UINT32: "<I",
+    GGUFValueType.INT32: "<i",
+    GGUFValueType.FLOAT32: "<f",
+    GGUFValueType.UINT64: "<Q",
+    GGUFValueType.INT64: "<q",
+    GGUFValueType.FLOAT64: "<d",
+}
+
+
 class GGMLType(enum.IntEnum):
     """Tensor storage types (ggml type ids)."""
 

@@ -334,8 +334,10 @@ def _kquant_affine_params(x: np.ndarray, qmax: float) -> tuple[np.ndarray, ...]:
     sub = x.reshape(x.shape[0], 8, 32)
     mn = sub.min(axis=2)
     mx = sub.max(axis=2)
-    scales = (mx - mn) / qmax  # per-sub-block real scale, >= 0
-    mins = np.maximum(0.0, -mn)  # represented minimum is -dmin*m <= 0
+    # the representable offset -dmin*m is <= 0, so for sub-blocks with a
+    # positive minimum the q range itself must span from 0 (not mn) up to mx
+    scales = (mx - np.minimum(mn, 0.0)) / qmax  # per-sub-block real scale, >= 0
+    mins = np.maximum(0.0, -mn)
     d = scales.max(axis=1, keepdims=True) / 63.0
     dmin = mins.max(axis=1, keepdims=True) / 63.0
     sc = np.clip(np.rint(_safe_div(scales, d)), 0, 63).astype(np.uint8)

@@ -20,23 +20,11 @@ from .constants import (
     GGUF_DEFAULT_ALIGNMENT,
     GGUF_MAGIC,
     KEY_ALIGNMENT,
+    SCALAR_FMT as _SCALAR_FMT,
     GGMLType,
     GGUFValueType,
 )
 from .quants import dequantize, type_size
-
-_SCALAR_FMT = {
-    GGUFValueType.UINT8: "<B",
-    GGUFValueType.INT8: "<b",
-    GGUFValueType.UINT16: "<H",
-    GGUFValueType.INT16: "<h",
-    GGUFValueType.UINT32: "<I",
-    GGUFValueType.INT32: "<i",
-    GGUFValueType.FLOAT32: "<f",
-    GGUFValueType.UINT64: "<Q",
-    GGUFValueType.INT64: "<q",
-    GGUFValueType.FLOAT64: "<d",
-}
 
 
 class GGUFFormatError(ValueError):
@@ -136,12 +124,26 @@ class GGUFReader:
         self._buf = buf
         self.metadata: dict[str, Any] = {}
         self.tensors: dict[str, GGUFTensor] = {}
-        self._parse()
+        try:
+            self._parse()
+        except Exception:
+            self.close()  # don't leak the fd/mapping on malformed files
+            raise
 
     def close(self) -> None:
-        self._buf.release()
+        """Close the file handle. Dequantized tensors are zero-copy views
+        over the mapping where possible; if any are still alive the mapping
+        itself stays valid until they are garbage-collected (the OS frees it
+        then), so close never invalidates outstanding arrays."""
+        try:
+            self._buf.release()
+        except BufferError:
+            pass
         if self._mmap is not None:
-            self._mmap.close()
+            try:
+                self._mmap.close()
+            except BufferError:
+                pass
         self._file.close()
 
     def __enter__(self) -> "GGUFReader":

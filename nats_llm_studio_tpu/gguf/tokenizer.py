@@ -77,6 +77,10 @@ class GGUFTokenizer:
         eos_id: int | None = None,
         add_bos: bool = True,
     ):
+        if model not in ("llama", "gpt2"):
+            raise NotImplementedError(
+                f"tokenizer model {model!r} not supported (llama/gpt2 families only)"
+            )
         self.model = model
         self.tokens = tokens
         self.scores = scores or []
@@ -104,6 +108,10 @@ class GGUFTokenizer:
         self._control_ids = {
             i for i, tt in enumerate(token_types or []) if tt == TokenType.CONTROL
         }
+        self.unk_id: int | None = next(
+            (i for i, tt in enumerate(token_types or []) if tt == TokenType.UNKNOWN),
+            self.vocab.get("<unk>"),
+        )
 
     # -- construction -------------------------------------------------------
 
@@ -145,10 +153,13 @@ class GGUFTokenizer:
             tid = self.vocab.get(p)
             if tid is not None:
                 ids.append(tid)
-            else:
-                for byte in p.encode("utf-8"):
-                    if byte in self._byte_tokens:
-                        ids.append(self._byte_tokens[byte])
+                continue
+            for byte in p.encode("utf-8"):
+                bid = self._byte_tokens.get(byte)
+                if bid is not None:
+                    ids.append(bid)
+                elif self.unk_id is not None:  # SentencePiece semantics
+                    ids.append(self.unk_id)
         return ids
 
     def _merge_by_score(self, pieces: list[str]) -> list[str]:

@@ -18,23 +18,11 @@ from .constants import (
     GGUF_MAGIC,
     GGUF_VERSION,
     KEY_ALIGNMENT,
+    SCALAR_FMT as _SCALAR_FMT,
     GGMLType,
     GGUFValueType,
 )
 from .quants import quantize, type_size
-
-_SCALAR_FMT = {
-    GGUFValueType.UINT8: "<B",
-    GGUFValueType.INT8: "<b",
-    GGUFValueType.UINT16: "<H",
-    GGUFValueType.INT16: "<h",
-    GGUFValueType.UINT32: "<I",
-    GGUFValueType.INT32: "<i",
-    GGUFValueType.FLOAT32: "<f",
-    GGUFValueType.UINT64: "<Q",
-    GGUFValueType.INT64: "<q",
-    GGUFValueType.FLOAT64: "<d",
-}
 
 
 def _guess_vtype(v: Any) -> GGUFValueType:
@@ -90,7 +78,7 @@ class GGUFWriter:
         elif vtype == GGUFValueType.STRING:
             self._w_string(out, v)
         elif vtype == GGUFValueType.ARRAY:
-            seq = list(v)
+            seq = v.tolist() if isinstance(v, np.ndarray) else list(v)
             et = elem_type
             if et is None:
                 et = _guess_vtype(seq[0]) if seq else GGUFValueType.INT32

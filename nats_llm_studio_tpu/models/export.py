@@ -1,0 +1,109 @@
+"""Export a params pytree to a GGUF file.
+
+Round-trips with ``load_params_from_gguf``: the fixture-creation path for
+integration tests (SURVEY.md §4.1) and the conversion path for publishing
+models into the Object Store bucket in the reference's
+``<publisher>/<model>/<file>.gguf`` layout (/root/reference/README.md:279-281).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from ..gguf.constants import GGMLType
+from ..gguf.writer import GGUFWriter
+from .config import ModelConfig
+
+
+def _np(x) -> np.ndarray:
+    arr = np.asarray(x, dtype=np.float32) if getattr(x, "dtype", None) != np.float32 else np.asarray(x)
+    return arr
+
+
+def _rope_interleave(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """Inverse of models.llama._rope_deinterleave: (first-half, second-half)
+    feature order back to GGUF's interleaved pairs."""
+    d_in = w.shape[0]
+    return (
+        w.reshape(d_in, n_heads, 2, head_dim // 2)
+        .transpose(0, 1, 3, 2)
+        .reshape(d_in, n_heads * head_dim)
+    )
+
+
+def export_params_to_gguf(
+    path: str | Path,
+    params: dict[str, Any],
+    cfg: ModelConfig,
+    tokenizer_md: dict[str, Any] | None = None,
+    name: str = "exported-model",
+    quant: GGMLType = GGMLType.F32,
+    norm_quant: GGMLType = GGMLType.F32,
+) -> Path:
+    w = GGUFWriter(path)
+    md: dict[str, Any] = {
+        "general.architecture": cfg.arch,
+        "general.name": name,
+        f"{cfg.arch}.block_count": cfg.n_layers,
+        f"{cfg.arch}.embedding_length": cfg.d_model,
+        f"{cfg.arch}.attention.head_count": cfg.n_heads,
+        f"{cfg.arch}.attention.head_count_kv": cfg.n_kv_heads,
+        f"{cfg.arch}.attention.key_length": cfg.head_dim,
+        f"{cfg.arch}.attention.value_length": cfg.head_dim,
+        f"{cfg.arch}.feed_forward_length": cfg.d_ff,
+        f"{cfg.arch}.rope.freq_base": cfg.rope_theta,
+        f"{cfg.arch}.attention.layer_norm_rms_epsilon": cfg.rms_eps,
+        f"{cfg.arch}.context_length": cfg.max_seq_len,
+        f"{cfg.arch}.vocab_size": cfg.vocab_size,
+    }
+    if cfg.is_moe:
+        md[f"{cfg.arch}.expert_count"] = cfg.n_experts
+        md[f"{cfg.arch}.expert_used_count"] = cfg.n_experts_used
+    if cfg.arch == "granite":
+        md["granite.embedding_scale"] = cfg.embedding_scale
+        md["granite.residual_scale"] = cfg.residual_scale
+        md["granite.logit_scale"] = 1.0 / cfg.logit_scale  # stored as divisor
+        if cfg.attention_scale is not None:
+            md["granite.attention.scale"] = cfg.attention_scale
+    w.add_dict(md)
+    if tokenizer_md:
+        w.add_dict(tokenizer_md)
+
+    def put(gguf_name: str, arr: np.ndarray, q: GGMLType) -> None:
+        w.add_tensor(gguf_name, arr, q)
+
+    # embeddings / head / final norm — stored [out, in] like llama.cpp writes
+    put("token_embd.weight", _np(params["embed"]), quant)
+    put("output_norm.weight", _np(params["out_norm"]), norm_quant)
+    if "lm_head" in params:
+        put("output.weight", _np(params["lm_head"]).T, quant)
+
+    blocks = params["blocks"]
+    L = cfg.n_layers
+    for i in range(L):
+        pre = f"blk.{i}"
+
+        def layer(key: str) -> np.ndarray:
+            return _np(blocks[key][i])
+
+        put(f"{pre}.attn_norm.weight", layer("attn_norm"), norm_quant)
+        put(f"{pre}.ffn_norm.weight", layer("ffn_norm"), norm_quant)
+        wq = _rope_interleave(layer("wq"), cfg.n_heads, cfg.head_dim)
+        wk = _rope_interleave(layer("wk"), cfg.n_kv_heads, cfg.head_dim)
+        put(f"{pre}.attn_q.weight", wq.T, quant)
+        put(f"{pre}.attn_k.weight", wk.T, quant)
+        put(f"{pre}.attn_v.weight", layer("wv").T, quant)
+        put(f"{pre}.attn_output.weight", layer("wo").T, quant)
+        if cfg.is_moe:
+            put(f"{pre}.ffn_gate_inp.weight", layer("router").T, GGMLType.F32)
+            put(f"{pre}.ffn_gate_exps.weight", layer("w_gate_e").transpose(0, 2, 1), quant)
+            put(f"{pre}.ffn_up_exps.weight", layer("w_up_e").transpose(0, 2, 1), quant)
+            put(f"{pre}.ffn_down_exps.weight", layer("w_down_e").transpose(0, 2, 1), quant)
+        else:
+            put(f"{pre}.ffn_gate.weight", layer("w_gate").T, quant)
+            put(f"{pre}.ffn_up.weight", layer("w_up").T, quant)
+            put(f"{pre}.ffn_down.weight", layer("w_down").T, quant)
+    return w.write()

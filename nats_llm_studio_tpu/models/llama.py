@@ -1,0 +1,252 @@
+"""Llama-family transformer in pure functional JAX.
+
+One module covers the whole north-star zoo (BASELINE.md): Llama-3 (dense),
+Granite-3.x (dense + embedding/residual/attention/logit multipliers), and
+Mixtral (MoE FFN) — in GGUF all three differ only by metadata scales and the
+``expert_count`` key, not by topology.
+
+TPU-first structure: all per-layer weights carry a leading ``[L]`` axis and
+the layer stack runs as a single ``lax.scan`` — one compiled block regardless
+of depth, with the KV cache threaded through as scan xs/ys. No Python loops,
+no dynamic shapes under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.layers import apply_rope, gqa_attention, rms_norm, rope_cos_sin, swiglu
+from .config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_block(
+    x: jax.Array,
+    p: Params,
+    cfg: ModelConfig,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    start_pos: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = (x @ p["wq"]).reshape(b, t, hq, d)
+    k = (x @ p["wk"]).reshape(b, t, hkv, d)
+    v = (x @ p["wv"]).reshape(b, t, hkv, d)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    zero = jnp.zeros((), start_pos.dtype)
+    write = jax.vmap(lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, zero, zero)))
+    k_cache = write(k_cache, k.astype(k_cache.dtype), start_pos)
+    v_cache = write(v_cache, v.astype(v_cache.dtype), start_pos)
+
+    out = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask, cfg.attn_scale)
+    return out.reshape(b, t, hq * d) @ p["wo"], k_cache, v_cache
+
+
+def _moe_ffn(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Mixtral top-k routed FFN, dense-dispatch form (every expert computes
+    every token; routing weights zero the unused ones). Correct everywhere;
+    the expert-parallel ``shard_map`` path in parallel/ replaces this on a
+    mesh with an ``expert`` axis."""
+    router_logits = (x @ p["router"]).astype(jnp.float32)  # [B,T,E]
+    top_w, top_idx = jax.lax.top_k(router_logits, cfg.n_experts_used)
+    top_w = jax.nn.softmax(top_w, axis=-1)  # normalize over the selected k
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32) * top_w[..., None], axis=-2
+    )  # dense combine weights [B,T,E]
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, p["w_gate_e"]))
+    up = jnp.einsum("btd,edf->btef", x, p["w_up_e"])
+    expert_out = jnp.einsum("btef,efd->bted", gate * up, p["w_down_e"])
+    return jnp.einsum("bted,bte->btd", expert_out, combine.astype(x.dtype))
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # int32 [B, T]
+    k_cache: jax.Array,  # [L, B, S, Hkv, D]
+    v_cache: jax.Array,
+    start_pos: jax.Array,  # int32 [B] — write offset per row (0 for prefill)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache).
+
+    Handles prefill (T > 1, start_pos = 0) and batched decode (T = 1,
+    start_pos = current length per row) with one trace. Right-padded prompts
+    are safe: pad keys sit at positions only pad queries can see, and decode
+    overwrites them in order.
+    """
+    b, t = tokens.shape
+    s_max = k_cache.shape[2]
+    positions = start_pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    key_pos = jnp.arange(s_max, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= positions[:, :, None]  # [B,T,S]
+
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype)) * cfg.embedding_scale
+
+    def block(x: jax.Array, layer: tuple[Params, jax.Array, jax.Array]):
+        p, kc, vc = layer
+        attn_out, kc, vc = _attention_block(
+            rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, kc, vc, start_pos, cos, sin, mask
+        )
+        x = x + attn_out * cfg.residual_scale
+        h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)
+        if cfg.is_moe:
+            ffn_out = _moe_ffn(h, p, cfg)
+        else:
+            ffn_out = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+        x = x + ffn_out * cfg.residual_scale
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(block, x, (params["blocks"], k_cache, v_cache))
+    x = rms_norm(x, params["out_norm"], cfg.rms_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    logits = (x @ lm_head).astype(jnp.float32) * cfg.logit_scale
+    return logits, k_cache, v_cache
+
+
+def make_cache(
+    cfg: ModelConfig, batch: int, seq_len: int | None = None, dtype: str | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """Zeroed KV cache pair, layout [L, B, S, Hkv, D] (SURVEY.md §5: heads on
+    a shardable axis so a TP axis annotates Hkv and a later sequence/ring axis
+    annotates S without relayout)."""
+    s = seq_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    dt = jnp.dtype(dtype or cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+# ---------------------------------------------------------------------------
+# parameter construction
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random small-scale init (tests / golden-logit fixtures)."""
+    dt = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(key, 24))
+
+    def rand(*shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * 0.02).astype(dt)
+
+    L, d, hq, hkv, hd, ff = (
+        cfg.n_layers,
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+    )
+    blocks: Params = {
+        "attn_norm": jnp.ones((L, d), dt),
+        "ffn_norm": jnp.ones((L, d), dt),
+        "wq": rand(L, d, hq * hd),
+        "wk": rand(L, d, hkv * hd),
+        "wv": rand(L, d, hkv * hd),
+        "wo": rand(L, hq * hd, d),
+    }
+    if cfg.is_moe:
+        e = cfg.n_experts
+        blocks |= {
+            "router": rand(L, d, e),
+            "w_gate_e": rand(L, e, d, ff),
+            "w_up_e": rand(L, e, d, ff),
+            "w_down_e": rand(L, e, ff, d),
+        }
+    else:
+        blocks |= {"w_gate": rand(L, d, ff), "w_up": rand(L, d, ff), "w_down": rand(L, ff, d)}
+    params: Params = {
+        "embed": rand(cfg.vocab_size, d),
+        "out_norm": jnp.ones((d,), dt),
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = rand(d, cfg.vocab_size)
+    return params
+
+
+# -- GGUF loading -----------------------------------------------------------
+
+
+def _rope_deinterleave(w: np.ndarray, n_heads: int, head_dim: int) -> np.ndarray:
+    """GGUF llama-family q/k weights expect interleaved-pair rotation
+    (ggml "NORM" RoPE); our kernel rotates (first-half, second-half). Permute
+    the output features so both agree: out index h*D + 2i+j -> h*D + j*D/2+i.
+    """
+    d_in = w.shape[0]
+    return (
+        w.reshape(d_in, n_heads, head_dim // 2, 2)
+        .transpose(0, 1, 3, 2)
+        .reshape(d_in, n_heads * head_dim)
+    )
+
+
+def load_params_from_gguf(reader, cfg: ModelConfig, dtype: str | None = None) -> Params:
+    """Build the stacked-params pytree from a GGUFReader.
+
+    Tensor names follow the public GGUF convention (token_embd, blk.N.*,
+    output_norm, output). Weights are stored [out, in] (after the reader's
+    dim reversal) and transposed here to [in, out] so forward() uses plain
+    ``x @ w`` — the layout XLA maps straight onto the MXU.
+    """
+    dt = jnp.dtype(dtype or cfg.dtype)
+
+    def t(name: str) -> np.ndarray:
+        return reader.tensor(name).to_numpy()
+
+    def mat(name: str) -> jax.Array:
+        return jnp.asarray(np.ascontiguousarray(t(name).T), dt)
+
+    L = cfg.n_layers
+    stacked: dict[str, list] = {}
+
+    def push(key: str, arr) -> None:
+        stacked.setdefault(key, []).append(arr)
+
+    for i in range(L):
+        pre = f"blk.{i}"
+        push("attn_norm", jnp.asarray(t(f"{pre}.attn_norm.weight"), dt))
+        push("ffn_norm", jnp.asarray(t(f"{pre}.ffn_norm.weight"), dt))
+        wq = np.ascontiguousarray(t(f"{pre}.attn_q.weight").T)
+        wk = np.ascontiguousarray(t(f"{pre}.attn_k.weight").T)
+        push("wq", jnp.asarray(_rope_deinterleave(wq, cfg.n_heads, cfg.head_dim), dt))
+        push("wk", jnp.asarray(_rope_deinterleave(wk, cfg.n_kv_heads, cfg.head_dim), dt))
+        push("wv", mat(f"{pre}.attn_v.weight"))
+        push("wo", mat(f"{pre}.attn_output.weight"))
+        if cfg.is_moe:
+            push("router", mat(f"{pre}.ffn_gate_inp.weight"))
+            # stacked expert tensors: reader shape (E, ff, d) -> [E, d, ff]
+            push("w_gate_e", jnp.asarray(t(f"{pre}.ffn_gate_exps.weight").transpose(0, 2, 1), dt))
+            push("w_up_e", jnp.asarray(t(f"{pre}.ffn_up_exps.weight").transpose(0, 2, 1), dt))
+            push("w_down_e", jnp.asarray(t(f"{pre}.ffn_down_exps.weight").transpose(0, 2, 1), dt))
+        else:
+            push("w_gate", mat(f"{pre}.ffn_gate.weight"))
+            push("w_up", mat(f"{pre}.ffn_up.weight"))
+            push("w_down", mat(f"{pre}.ffn_down.weight"))
+
+    params: Params = {
+        "embed": jnp.asarray(t("token_embd.weight"), dt),
+        "out_norm": jnp.asarray(t("output_norm.weight"), dt),
+        "blocks": {k: jnp.stack(v) for k, v in stacked.items()},
+    }
+    if "output.weight" in reader.tensors:
+        params["lm_head"] = mat("output.weight")
+    return params

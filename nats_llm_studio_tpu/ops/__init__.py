@@ -1,0 +1,11 @@
+"""Numeric building blocks (pure JAX + Pallas TPU kernels).
+
+The reference has no tensor code at all — every FLOP lives in the external
+llama.cpp engine (/root/reference/README.md:3-7). These ops are the in-tree
+replacement, written TPU-first: bf16 matmuls for the MXU, f32 accumulation
+for softmax/norms, static shapes, no data-dependent control flow under jit.
+"""
+
+from .layers import apply_rope, gqa_attention, rms_norm, rope_cos_sin, swiglu
+
+__all__ = ["rms_norm", "rope_cos_sin", "apply_rope", "gqa_attention", "swiglu"]

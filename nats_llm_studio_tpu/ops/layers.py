@@ -1,0 +1,78 @@
+"""Transformer building blocks: RMSNorm, RoPE, GQA attention, SwiGLU.
+
+Numerics policy (TPU-first): inputs/weights may be bf16 (MXU-native); all
+reductions — norms, softmax — run in f32 and cast back. Shapes are static and
+batch-major so XLA tiles matmuls onto the MXU without relayout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Root-mean-square layer norm (no mean subtraction, no bias)."""
+    xf = x.astype(jnp.float32)
+    rrms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rrms).astype(x.dtype) * weight
+
+
+def rope_cos_sin(
+    positions: jax.Array, head_dim: int, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary position embedding.
+
+    positions: int32 [...]; returns (cos, sin) each [..., head_dim // 2] f32.
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (x[2i], x[2i+1]) — GGUF/"NEOX" interleaving is handled by
+    the weight loader, so here the pairing is (first half, second half).
+
+    x: [B, T, H, D]; cos/sin: [B, T, D/2] (broadcast over heads).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def gqa_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array,
+    scale: float,
+) -> jax.Array:
+    """Grouped-query attention with f32 softmax.
+
+    q: [B, T, Hq, D]; k, v: [B, S, Hkv, D]; mask: bool [B, T, S] (True = may
+    attend). Hq must be a multiple of Hkv (the group size). Returns
+    [B, T, Hq, D] in q.dtype.
+    """
+    b, t, hq, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, t, hkv, g, d)
+    logits = jnp.einsum("bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, hq, d)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ).
+
+    Weights are [d_in, d_out] row-major so the matmuls are plain ``x @ w``.
+    """
+    gate = jax.nn.silu(x @ w_gate)
+    return (gate * (x @ w_up)) @ w_down

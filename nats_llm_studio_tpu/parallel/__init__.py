@@ -1,0 +1,23 @@
+"""Device mesh + sharding rules (TP/DP/EP over ICI, DCN-ready).
+
+The reference's only "distributed backend" is NATS itself (SURVEY.md §5):
+request-reply RPC + queue groups; tensor math lives in an external engine.
+Here the tensor plane is XLA collectives over ICI — GSPMD inserts
+all-gather/reduce-scatter from NamedSharding annotations (jit), no NCCL
+analog to hand-write — while NATS stays the control plane unchanged.
+"""
+
+from .mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP, build_mesh, parse_mesh_spec
+from .sharding import param_sharding_rules, shard_cache, shard_params
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_TP",
+    "AXIS_EP",
+    "AXIS_SP",
+    "build_mesh",
+    "parse_mesh_spec",
+    "param_sharding_rules",
+    "shard_params",
+    "shard_cache",
+]

@@ -1,0 +1,56 @@
+"""Mesh construction from a spec string (env var ``TPU_MESH``).
+
+Axis vocabulary: ``dp`` (data/batch), ``tp`` (tensor: heads + MLP), ``ep``
+(experts), ``sp`` (sequence/context — reserved for ring attention). A spec is
+``"tp=8"`` or ``"dp=2,tp=4"``; ``"auto"``/empty uses all local devices on tp.
+
+Multi-host: when ``jax.distributed.initialize`` has run, ``jax.devices()``
+spans hosts and the same specs build DCN-crossing meshes; keep dp outermost
+so its collectives ride DCN and tp's ride ICI (devices are enumerated
+host-major).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+AXIS_SP = "sp"
+_KNOWN = (AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP)  # construction order: dp outermost
+
+
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """``"dp=2,tp=4"`` -> {"dp": 2, "tp": 4} (order normalized dp,ep,sp,tp)."""
+    spec = (spec or "").strip().lower()
+    if spec in ("", "auto"):
+        return {}
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, val = part.strip().partition("=")
+        if name not in _KNOWN:
+            raise ValueError(f"unknown mesh axis {name!r} (known: {_KNOWN})")
+        n = int(val)
+        if n <= 0:
+            raise ValueError(f"mesh axis {name}={n} must be positive")
+        out[name] = n
+    return {k: out[k] for k in _KNOWN if k in out}
+
+
+def build_mesh(spec: str | dict[str, int] = "", devices=None) -> Mesh:
+    """Build a Mesh from a spec; validates the axis product against the
+    device count. Empty/"auto" puts every device on the tp axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = parse_mesh_spec(spec) if isinstance(spec, str) else dict(spec)
+    if not axes:
+        axes = {AXIS_TP: len(devices)}
+    n = 1
+    for v in axes.values():
+        n *= v
+    if n != len(devices):
+        raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
+    arr = mesh_utils.create_device_mesh(tuple(axes.values()), devices=devices)
+    return Mesh(arr, tuple(axes.keys()))

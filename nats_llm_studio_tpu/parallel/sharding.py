@@ -1,0 +1,114 @@
+"""NamedSharding rules for the stacked-params pytree.
+
+Megatron-style TP (BASELINE.md config 3: Llama-3-70B TP=8 on v5e-8): QKV and
+FFN-in sharded on their output-features axis, attn-out and FFN-down on their
+input axis — so each block does local matmuls and GSPMD inserts exactly one
+all-reduce after attention and one after the MLP. Experts shard on the ep
+axis (config 4: Mixtral). The KV cache shards heads on tp and batch on dp; S
+stays unsharded so a future sp/ring axis is additive (SURVEY.md §5).
+
+Weights keep a leading [L] stack axis (lax.scan), so every rule below starts
+with None for L.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from .mesh import AXIS_DP, AXIS_EP, AXIS_TP
+
+
+def _axis(mesh: Mesh, name: str) -> str | None:
+    """Use an axis only if the mesh has it with size > 1."""
+    return name if name in mesh.axis_names and mesh.shape[name] > 1 else None
+
+
+def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
+    """PartitionSpec per params-pytree key (blocks.* keys are the stacked
+    per-layer weights)."""
+    tp = _axis(mesh, AXIS_TP)
+    ep = _axis(mesh, AXIS_EP)
+    return {
+        "embed": P(None, None),  # replicated: read once per token, cheap
+        "out_norm": P(None),
+        "lm_head": P(None, tp),  # vocab-sharded logits; argmax/sample gathers
+        "blocks.attn_norm": P(None, None),
+        "blocks.ffn_norm": P(None, None),
+        "blocks.wq": P(None, None, tp),
+        "blocks.wk": P(None, None, tp),
+        "blocks.wv": P(None, None, tp),
+        "blocks.wo": P(None, tp, None),
+        "blocks.w_gate": P(None, None, tp),
+        "blocks.w_up": P(None, None, tp),
+        "blocks.w_down": P(None, tp, None),
+        "blocks.router": P(None, None, None),
+        "blocks.w_gate_e": P(None, ep, None, tp),
+        "blocks.w_up_e": P(None, ep, None, tp),
+        "blocks.w_down_e": P(None, ep, tp, None),
+    }
+
+
+def _flatten_keys(params: dict[str, Any], prefix: str = "") -> dict[str, Any]:
+    out = {}
+    for k, v in params.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_keys(v, f"{path}."))
+        else:
+            out[path] = v
+    return out
+
+
+def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """device_put every leaf with its rule (replicated if no rule matches).
+
+    For giant checkpoints prefer loading shard-by-shard (store/loader);
+    this helper is for params already materialized on host.
+    """
+    rules = param_sharding_rules(mesh)
+
+    def place(path: str, leaf):
+        spec = rules.get(path, P())
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    def walk(node: dict[str, Any], prefix: str = "") -> dict[str, Any]:
+        out = {}
+        for k, v in node.items():
+            path = f"{prefix}{k}"
+            out[k] = walk(v, f"{path}.") if isinstance(v, dict) else place(path, v)
+        return out
+
+    return walk(params)
+
+
+def cache_spec(mesh: Mesh) -> P:
+    """KV cache [L, B, S, Hkv, D]: batch on dp, heads on tp."""
+    return P(None, _axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), None)
+
+
+def shard_cache(k_cache, v_cache, mesh: Mesh):
+    sh = NamedSharding(mesh, cache_spec(mesh))
+    return jax.device_put(k_cache, sh), jax.device_put(v_cache, sh)
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Token/position arrays [B, ...]: batch on dp."""
+    return P(_axis(mesh, AXIS_DP))
+
+
+def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig) -> None:
+    """Fail fast on indivisible shardings instead of cryptic XLA errors."""
+    tp = mesh.shape.get(AXIS_TP, 1)
+    ep = mesh.shape.get(AXIS_EP, 1)
+    if cfg.n_kv_heads % tp and tp > 1:
+        raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
+    if cfg.n_heads % tp and tp > 1:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    if cfg.d_ff % tp and tp > 1:
+        raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
+    if cfg.is_moe and ep > 1 and cfg.n_experts % ep:
+        raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")

@@ -1,0 +1,305 @@
+"""LocalRegistry: the in-process replacement for LM Studio + the `lms` CLI.
+
+Wires the four reference capabilities (list/pull/delete/chat —
+/root/reference/nats_llm_studio.go:22-179) to the in-tree stack: ModelStore
+(cache + Object Store), GGUF loader, and the JAX Generator. Model listings
+are LM-Studio-shaped (README.md:66-80) so existing clients keep working.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, AsyncIterator
+
+import jax
+
+from ..engine.generator import Generator, SamplingParams
+from ..gguf.reader import GGUFReader
+from ..gguf.tokenizer import GGUFTokenizer
+from ..models.config import ModelConfig
+from ..models.llama import load_params_from_gguf
+from ..parallel.sharding import shard_params, validate_mesh_for_config
+from ..store.manager import ModelStore, StoreError
+from ..utils.nuid import next_nuid
+from .api import ChatEngine, EngineError, ModelNotFound, Registry
+from .template import render_chat_template, stop_token_ids
+
+log = logging.getLogger(__name__)
+
+
+class JaxChatEngine(ChatEngine):
+    """One loaded model: tokenizer + jitted generator behind a single-owner
+    lock (the decode loop is the one shared-mutable structure — SURVEY.md §5
+    race-detection note)."""
+
+    def __init__(
+        self,
+        model_id: str,
+        generator: Generator,
+        tokenizer: GGUFTokenizer,
+        cfg: ModelConfig,
+        meta: dict[str, Any],
+        quantization: str = "",
+    ):
+        self.model_id = model_id
+        self.generator = generator
+        self.tokenizer = tokenizer
+        self.cfg = cfg
+        self.meta = meta
+        self.quantization = quantization
+        self._lock = asyncio.Lock()
+        self._stop_ids = stop_token_ids(tokenizer)
+
+    # -- internals -----------------------------------------------------------
+
+    def _sampling(self, payload: dict) -> SamplingParams:
+        return SamplingParams(
+            temperature=float(payload.get("temperature", 0.8)),
+            top_p=float(payload.get("top_p", 1.0)),
+            top_k=int(payload.get("top_k", 0)),
+            max_tokens=int(payload.get("max_tokens") or payload.get("max_completion_tokens") or 256),
+            seed=payload.get("seed"),
+            stop_ids=self._stop_ids,
+        )
+
+    def _encode_prompt(self, payload: dict) -> list[int]:
+        messages = payload.get("messages") or []
+        prompt = render_chat_template(self.meta, messages, add_generation_prompt=True)
+        return self.tokenizer.encode(prompt)
+
+    def _completion(self, text: str, n_prompt: int, n_out: int, finish: str,
+                    stats=None) -> dict:
+        """OpenAI-style body with LM Studio's stats block
+        (/root/reference/README.md:208-231)."""
+        out: dict[str, Any] = {
+            "id": f"chatcmpl-{next_nuid()[:12].lower()}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": finish,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_out,
+                "total_tokens": n_prompt + n_out,
+            },
+        }
+        if stats is not None:
+            out["stats"] = {
+                "tokens_per_second": round(stats.decode_tok_s, 2),
+                "time_to_first_token": round(stats.ttft_s, 4),
+                "generation_time": round(stats.total_s, 4),
+            }
+        return out
+
+    # -- ChatEngine ----------------------------------------------------------
+
+    async def chat(self, payload: dict) -> dict:
+        parts = []
+        final = None
+        async for chunk in self.chat_stream(payload):
+            if chunk.get("object") == "chat.completion":
+                final = chunk
+            else:
+                parts.append(chunk["choices"][0]["delta"].get("content", ""))
+        return final if final is not None else self._completion("".join(parts), 0, 0, "stop")
+
+    async def chat_stream(self, payload: dict) -> AsyncIterator[dict]:
+        prompt_ids = self._encode_prompt(payload)
+        sp = self._sampling(payload)
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        _DONE = object()
+
+        def run() -> None:
+            try:
+                stats = None
+                for tok, stats in self.generator.generate(prompt_ids, sp):
+                    loop.call_soon_threadsafe(queue.put_nowait, ("tok", tok, stats))
+                loop.call_soon_threadsafe(queue.put_nowait, ("end", None, stats))
+            except Exception as e:  # noqa: BLE001 — surfaced as EngineError below
+                loop.call_soon_threadsafe(queue.put_nowait, ("err", e, None))
+
+        async with self._lock:  # single owner of the decode loop
+            task = loop.run_in_executor(None, run)
+            toks: list[int] = []
+            emitted = 0
+            stats = None
+            try:
+                while True:
+                    kind, item, st = await queue.get()
+                    if kind == "err":
+                        raise EngineError(str(item)) from item
+                    if kind == "end":
+                        stats = st
+                        break
+                    toks.append(item)
+                    stats = st
+                    # decode incrementally; emit only completed UTF-8 text
+                    text = self.tokenizer.decode(toks)
+                    if len(text) > emitted and not text.endswith("�"):
+                        yield {
+                            "object": "chat.completion.chunk",
+                            "model": self.model_id,
+                            "choices": [
+                                {
+                                    "index": 0,
+                                    "delta": {"role": "assistant", "content": text[emitted:]},
+                                    "finish_reason": None,
+                                }
+                            ],
+                        }
+                        emitted = len(text)
+            finally:
+                await task
+        text = self.tokenizer.decode(toks)
+        if len(text) > emitted:
+            # flush text held back by the incomplete-UTF-8 guard so the chunk
+            # stream concatenates to exactly the aggregate completion
+            yield {
+                "object": "chat.completion.chunk",
+                "model": self.model_id,
+                "choices": [
+                    {
+                        "index": 0,
+                        "delta": {"role": "assistant", "content": text[emitted:]},
+                        "finish_reason": None,
+                    }
+                ],
+            }
+        finish = "length" if stats and stats.completion_tokens >= sp.max_tokens else "stop"
+        yield self._completion(text, len(prompt_ids), len(toks), finish, stats)
+
+    def info(self) -> dict:
+        return {
+            "id": self.model_id,
+            "object": "model",
+            "type": "llm",
+            "publisher": self.model_id.split("/")[0] if "/" in self.model_id else "local",
+            "arch": self.cfg.arch,
+            "quantization": self.quantization,
+            "state": "loaded",
+            "max_context_length": self.cfg.max_seq_len,
+            "loaded_context_length": self.generator.max_seq,
+        }
+
+    async def unload(self) -> None:
+        self.generator = None  # type: ignore[assignment]
+
+
+class LocalRegistry(Registry):
+    """Model lifecycle over a ModelStore + JAX engines."""
+
+    def __init__(
+        self,
+        store: ModelStore,
+        mesh=None,
+        dtype: str | None = None,
+        max_seq_len: int | None = None,
+        warmup: bool = False,
+    ):
+        self.store = store
+        self.mesh = mesh
+        self.dtype = dtype or ("float32" if jax.default_backend() == "cpu" else "bfloat16")
+        self.max_seq_len = max_seq_len
+        self.warmup = warmup
+        self._engines: dict[str, JaxChatEngine] = {}
+        self._load_lock = asyncio.Lock()
+        self._requests = 0
+
+    # -- Registry ------------------------------------------------------------
+
+    async def list_models(self) -> dict:
+        entries = []
+        for cm in self.store.cached():
+            eng = self._engines.get(cm.model_id)
+            if eng is not None:
+                entries.append(eng.info())
+            else:
+                entries.append(
+                    {
+                        "id": cm.model_id,
+                        "object": "model",
+                        "type": "llm",
+                        "publisher": cm.publisher,
+                        "state": "not-loaded",
+                        "size_bytes": cm.size,
+                    }
+                )
+        return {"object": "list", "data": entries}
+
+    async def pull(self, identifier: str) -> str:
+        try:
+            _, transcript = await self.store.pull(identifier)
+        except StoreError as e:
+            raise EngineError(str(e)) from None
+        return transcript
+
+    async def delete(self, model_id: str) -> str:
+        eng = self._engines.pop(model_id, None)
+        if eng is not None:
+            await eng.unload()
+        try:
+            return self.store.delete_local(model_id)
+        except StoreError as e:
+            err = EngineError(str(e))
+            err.dir = e.dir  # surfaced in the error envelope (go :304-313)
+            raise err from None
+
+    async def sync_from_bucket(self, name: str, model_id: str | None = None) -> str:
+        try:
+            path, _ = await self.store.pull(name)
+        except StoreError as e:
+            raise EngineError(str(e)) from None
+        return str(path)
+
+    async def get_engine(self, model_id: str) -> ChatEngine:
+        self._requests += 1
+        eng = self._engines.get(model_id)
+        if eng is not None:
+            return eng
+        async with self._load_lock:
+            eng = self._engines.get(model_id)
+            if eng is not None:
+                return eng
+            cm = self.store.lookup(model_id)
+            if cm is None:
+                raise ModelNotFound(model_id)
+            eng = await asyncio.to_thread(self._load, cm.model_id, str(cm.gguf_path))
+            self._engines[cm.model_id] = eng
+            return eng
+
+    def _load(self, model_id: str, path: str) -> JaxChatEngine:
+        t0 = time.perf_counter()
+        reader = GGUFReader(path)
+        cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(dtype=self.dtype)
+        tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
+        params = load_params_from_gguf(reader, cfg)
+        quant = {t.ggml_type.name for t in reader.tensors.values()}
+        if self.mesh is not None:
+            validate_mesh_for_config(self.mesh, cfg)
+            params = shard_params(params, self.mesh)
+        meta = dict(reader.metadata)
+        reader.close()
+        gen = Generator(params, cfg, max_seq_len=self.max_seq_len)
+        if self.warmup:
+            gen.warmup()
+        log.info("loaded %s in %.1fs (%s, %s)", model_id, time.perf_counter() - t0,
+                 cfg.arch, self.dtype)
+        return JaxChatEngine(
+            model_id, gen, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
+        )
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "models_cached": len(self.store.cached()),
+            "models_loaded": len(self._engines),
+            "backend": jax.default_backend(),
+        }

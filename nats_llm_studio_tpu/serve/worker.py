@@ -98,17 +98,17 @@ class Worker:
 
     # -- envelope helpers ----------------------------------------------------
 
-    async def _respond_json(self, msg: Msg, payload: bytes) -> None:
+    async def _respond_json(self, msg: Msg, payload: bytes, headers=None) -> None:
         try:
-            await msg.respond(payload)
+            await msg.respond(payload, headers=headers)
         except (ConnectionError, ValueError):
             log.warning("failed to respond on %s", msg.subject)
 
     async def _respond_ok(self, msg: Msg, data=None) -> None:
         await self._respond_json(msg, envelope_ok(data))
 
-    async def _respond_error(self, msg: Msg, error: str, data=None) -> None:
-        await self._respond_json(msg, envelope_error(error, data))
+    async def _respond_error(self, msg: Msg, error: str, data=None, headers=None) -> None:
+        await self._respond_json(msg, envelope_error(error, data), headers=headers)
 
     # -- handlers ------------------------------------------------------------
 
@@ -237,15 +237,8 @@ class Worker:
         """Error reply that, mid-stream, still carries the terminal
         ``Nats-Stream-Done`` header so ``request_stream`` consumers end
         cleanly instead of waiting out their idle timeout."""
-        if streaming and self.nc is not None and msg.reply:
-            try:
-                await self.nc.publish(
-                    msg.reply, envelope_error(error, data), headers={"Nats-Stream-Done": "1"}
-                )
-            except (ConnectionError, ValueError):
-                log.warning("failed to publish terminal error on %s", msg.reply)
-        else:
-            await self._respond_error(msg, error, data)
+        headers = {"Nats-Stream-Done": "1"} if streaming else None
+        await self._respond_error(msg, error, data, headers=headers)
 
     async def _chat_streaming(self, msg: Msg, engine, payload: dict) -> None:
         assert self.nc is not None

@@ -1,0 +1,15 @@
+"""Model repository: JetStream-style Object Store + local model cache.
+
+The reference describes JetStream Object Store as the central ``.gguf``
+repository but never implements it (/root/reference/README.md:250-318; the
+``sync_model_from_bucket`` subject is explicitly conceptual, :286-289). Here
+it is first-class: a server-side store module on the embedded broker speaking
+the public JetStream wire subjects (``$JS.API.>``, ``$O.<bucket>.>``), a
+client, and a model manager maintaining the reference's on-disk cache layout
+``<models_dir>/<publisher>/<model>/`` (nats_llm_studio.go:120).
+"""
+
+from .manager import ModelStore
+from .objectstore import JetStreamStoreModule
+
+__all__ = ["ModelStore", "JetStreamStoreModule"]

@@ -1,0 +1,175 @@
+"""ModelStore: local GGUF cache + Object Store distribution.
+
+Reproduces the reference's on-disk contract — models live at
+``<models_dir>/<publisher>/<model>/*.gguf`` (nats_llm_studio.go:120, README
+default ``~/.lmstudio/models``) and bucket objects are named
+``<publisher>/<model>/<file>.gguf`` (README.md:279-281). The reference's
+delete-path duplication bug (publisher derived from an id that already
+contains it, nats_llm_studio.go:111-120 — SURVEY.md §2.1) is consciously
+fixed here: ids are always ``publisher/model`` and never re-prefixed.
+"""
+
+from __future__ import annotations
+
+import shutil
+from dataclasses import dataclass
+from pathlib import Path
+
+from ..transport.jetstream import ObjectNotFound, ObjectStore
+
+
+class StoreError(Exception):
+    def __init__(self, msg: str, dir: str | None = None):
+        super().__init__(msg)
+        self.dir = dir
+
+
+@dataclass
+class CachedModel:
+    model_id: str  # "publisher/model"
+    publisher: str
+    name: str
+    path: Path  # directory
+    files: list[Path]  # .gguf files inside
+
+    @property
+    def gguf_path(self) -> Path:
+        return self.files[0]
+
+    @property
+    def size(self) -> int:
+        return sum(f.stat().st_size for f in self.files)
+
+
+def split_model_id(model_id: str) -> tuple[str, str]:
+    """"publisher/model" -> (publisher, model); bare names get publisher
+    "local" (mirrors the reference's fallback of deriving the publisher from
+    the id prefix, nats_llm_studio.go:112-118, without the duplication)."""
+    model_id = model_id.strip().strip("/")
+    if "/" in model_id:
+        pub, _, name = model_id.partition("/")
+        return pub, name
+    return "local", model_id
+
+
+class ModelStore:
+    """Local cache directory + optional Object Store bucket."""
+
+    def __init__(self, models_dir: str | Path, objstore: ObjectStore | None = None,
+                 bucket: str = "llm-models"):
+        self.models_dir = Path(models_dir).expanduser()
+        self.models_dir.mkdir(parents=True, exist_ok=True)
+        self.objstore = objstore
+        self.bucket = bucket
+
+    # -- local cache ---------------------------------------------------------
+
+    def model_dir(self, model_id: str) -> Path:
+        pub, name = split_model_id(model_id)
+        return self.models_dir / pub / name
+
+    def cached(self) -> list[CachedModel]:
+        out = []
+        for pub_dir in sorted(p for p in self.models_dir.iterdir() if p.is_dir()):
+            for model_dir in sorted(p for p in pub_dir.iterdir() if p.is_dir()):
+                files = sorted(model_dir.glob("*.gguf"))
+                if files:
+                    out.append(
+                        CachedModel(
+                            model_id=f"{pub_dir.name}/{model_dir.name}",
+                            publisher=pub_dir.name,
+                            name=model_dir.name,
+                            path=model_dir,
+                            files=files,
+                        )
+                    )
+        return out
+
+    def lookup(self, model_id: str) -> CachedModel | None:
+        d = self.model_dir(model_id)
+        files = sorted(d.glob("*.gguf")) if d.is_dir() else []
+        if not files:
+            return None
+        pub, name = split_model_id(model_id)
+        return CachedModel(f"{pub}/{name}", pub, name, d, files)
+
+    def delete_local(self, model_id: str) -> str:
+        """Remove the model directory; returns the deleted dir (the
+        reference replies ``deleted_dir``, nats_llm_studio.go:316-323)."""
+        d = self.model_dir(model_id)
+        if not d.is_dir():
+            raise StoreError(f"model directory not found: {d}", dir=str(d))
+        shutil.rmtree(d)
+        # drop the publisher dir too if now empty (keep models_dir tidy)
+        try:
+            d.parent.rmdir()
+        except OSError:
+            pass
+        return str(d)
+
+    def import_file(self, src: str | Path, model_id: str) -> Path:
+        """Copy a local .gguf into the cache layout (the `lms import` analog,
+        /root/reference/README.md:316)."""
+        src = Path(src)
+        dest_dir = self.model_dir(model_id)
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        dest = dest_dir / src.name
+        shutil.copyfile(src, dest)
+        return dest
+
+    # -- object store --------------------------------------------------------
+
+    def _require_store(self) -> ObjectStore:
+        if self.objstore is None:
+            raise StoreError("object store not configured")
+        return self.objstore
+
+    async def publish_model(self, model_id: str, gguf_path: str | Path | None = None) -> str:
+        """Upload a cached model (or explicit file) to the bucket as
+        ``<publisher>/<model>/<file>.gguf``. Returns the object name."""
+        store = self._require_store()
+        if gguf_path is None:
+            cm = self.lookup(model_id)
+            if cm is None:
+                raise StoreError(f"model {model_id!r} not in local cache")
+            gguf_path = cm.gguf_path
+        gguf_path = Path(gguf_path)
+        pub, name = split_model_id(model_id)
+        obj_name = f"{pub}/{name}/{gguf_path.name}"
+        await store.ensure_bucket(self.bucket)
+        await store.put(self.bucket, obj_name, gguf_path.read_bytes())
+        return obj_name
+
+    async def pull(self, identifier: str) -> tuple[Path, str]:
+        """Fetch a model from the bucket into the local cache (the `lms get`
+        replacement, nats_llm_studio.go:46-59; conceptual sync flow
+        README.md:286-318). ``identifier`` is an object name
+        ``publisher/model/file.gguf`` or a model id ``publisher/model``.
+        Returns (local_path, transcript)."""
+        store = self._require_store()
+        lines = [f"pulling {identifier!r} from bucket {self.bucket!r}"]
+        obj_name = identifier.strip().strip("/")
+        if not obj_name.endswith(".gguf"):
+            # model id: find the first object under that prefix
+            objs = await store.list(self.bucket)
+            matches = [o for o in objs if o.name.startswith(obj_name + "/")]
+            if not matches:
+                raise StoreError(f"no objects under {obj_name!r} in bucket {self.bucket!r}")
+            obj_name = matches[0].name
+            lines.append(f"resolved to object {obj_name!r}")
+        try:
+            data = await store.get(self.bucket, obj_name)
+        except ObjectNotFound as e:
+            raise StoreError(f"object {obj_name!r} not found: {e}") from None
+        parts = obj_name.split("/")
+        if len(parts) < 3:
+            raise StoreError(
+                f"object name {obj_name!r} must be <publisher>/<model>/<file>.gguf"
+            )
+        pub, name, fname = parts[0], "/".join(parts[1:-1]), parts[-1]
+        dest_dir = self.models_dir / pub / name
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        dest = dest_dir / fname
+        dest.write_bytes(data)
+        lines.append(f"wrote {len(data)} bytes to {dest}")
+        return dest, "\n".join(lines)

@@ -1,0 +1,298 @@
+"""Server-side object store module for the embedded broker.
+
+Implements the slice of the public JetStream wire API that the Object Store
+pattern needs, so the in-tree client (transport/jetstream.py) — and any
+foreign client using direct-get — can store/fetch model blobs:
+
+* ``$JS.API.STREAM.CREATE.<name>`` / ``INFO`` / ``DELETE`` / ``PURGE`` /
+  ``NAMES`` — JSON request-reply
+* ``$JS.API.DIRECT.GET.<name>`` — ``{"last_by_subj"}`` or
+  ``{"seq", "next_by_subj"}`` lookups, replied with Nats-Subject /
+  Nats-Sequence headers (404 via status header)
+* message capture for stream subjects with ``Nats-Rollup: sub`` per-subject
+  rollup (object-store metadata updates)
+
+State is in-memory with optional file-backed persistence of chunk payloads
+under a store dir (the JetStream file-store analog, setup_unix.sh:87-95).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..transport.broker import EmbeddedBroker
+from ..utils import subject_matches
+
+log = logging.getLogger(__name__)
+
+_API_PREFIX = "$JS.API."
+
+
+@dataclass
+class _StoredMsg:
+    seq: int
+    subject: str
+    headers: dict[str, str] | None
+    payload: bytes
+    ts: float
+
+
+@dataclass
+class _Stream:
+    name: str
+    config: dict
+    next_seq: int = 1
+    msgs: list[_StoredMsg] = field(default_factory=list)  # ordered by seq
+
+    @property
+    def subjects(self) -> list[str]:
+        return list(self.config.get("subjects") or [])
+
+    def captures(self, subject: str) -> bool:
+        return any(subject_matches(pat, subject) for pat in self.subjects)
+
+    def bytes_total(self) -> int:
+        return sum(len(m.payload) for m in self.msgs)
+
+
+class JetStreamStoreModule:
+    """Attach with ``JetStreamStoreModule(broker).install()``."""
+
+    def __init__(self, broker: EmbeddedBroker, store_dir: str | Path | None = None):
+        self.broker = broker
+        self.streams: dict[str, _Stream] = {}
+        self.store_dir = Path(store_dir) if store_dir else None
+        if self.store_dir:
+            self.store_dir.mkdir(parents=True, exist_ok=True)
+            self._load_persisted()
+
+    def install(self) -> "JetStreamStoreModule":
+        self.broker.register_internal(_API_PREFIX + ">", self._on_api)
+        self.broker.register_internal("$O.>", self._on_capture)
+        return self
+
+    # -- persistence (file-store analog) ------------------------------------
+
+    def _stream_file(self, name: str) -> Path:
+        assert self.store_dir is not None
+        return self.store_dir / f"{name}.jsl"
+
+    def _persist_append(self, stream: _Stream, msg: _StoredMsg) -> None:
+        if not self.store_dir:
+            return
+        rec = {
+            "seq": msg.seq,
+            "subject": msg.subject,
+            "headers": msg.headers,
+            "payload_hex": msg.payload.hex(),
+            "ts": msg.ts,
+        }
+        with open(self._stream_file(stream.name), "a") as f:
+            f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def _persist_rewrite(self, stream: _Stream) -> None:
+        if not self.store_dir:
+            return
+        path = self._stream_file(stream.name)
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"config": stream.config, "next_seq": stream.next_seq}) + "\n")
+            for m in stream.msgs:
+                f.write(
+                    json.dumps(
+                        {
+                            "seq": m.seq,
+                            "subject": m.subject,
+                            "headers": m.headers,
+                            "payload_hex": m.payload.hex(),
+                            "ts": m.ts,
+                        },
+                        separators=(",", ":"),
+                    )
+                    + "\n"
+                )
+        tmp.replace(path)
+
+    def _load_persisted(self) -> None:
+        assert self.store_dir is not None
+        for f in sorted(self.store_dir.glob("*.jsl")):
+            try:
+                lines = f.read_text().splitlines()
+                head = json.loads(lines[0])
+                st = _Stream(name=f.stem, config=head["config"], next_seq=head["next_seq"])
+                for line in lines[1:]:
+                    r = json.loads(line)
+                    st.msgs.append(
+                        _StoredMsg(
+                            r["seq"], r["subject"], r.get("headers"),
+                            bytes.fromhex(r["payload_hex"]), r.get("ts", 0.0),
+                        )
+                    )
+                self.streams[st.name] = st
+            except (ValueError, KeyError, IndexError):
+                log.warning("skipping corrupt stream file %s", f)
+
+    # -- capture -------------------------------------------------------------
+
+    async def _on_capture(self, subject: str, payload: bytes, reply, headers) -> None:
+        if subject.startswith(_API_PREFIX):
+            return
+        for stream in self.streams.values():
+            if not stream.captures(subject):
+                continue
+            rollup = (headers or {}).get("Nats-Rollup")
+            if rollup == "sub":
+                stream.msgs = [m for m in stream.msgs if m.subject != subject]
+            elif rollup == "all":
+                stream.msgs.clear()
+            msg = _StoredMsg(stream.next_seq, subject, headers, payload, time.time())
+            stream.next_seq += 1
+            stream.msgs.append(msg)
+            if rollup:
+                self._persist_rewrite(stream)
+            else:
+                self._persist_append(stream, msg)
+            if reply:
+                ack = {"stream": stream.name, "seq": msg.seq}
+                await self.broker.publish_internal(reply, json.dumps(ack).encode())
+
+    # -- API -----------------------------------------------------------------
+
+    async def _reply_json(self, reply: str | None, obj: dict) -> None:
+        if reply:
+            await self.broker.publish_internal(reply, json.dumps(obj).encode())
+
+    async def _reply_error(self, reply: str | None, code: int, desc: str) -> None:
+        await self._reply_json(
+            reply, {"error": {"code": code, "err_code": code * 100, "description": desc}}
+        )
+
+    async def _on_api(self, subject: str, payload: bytes, reply, headers) -> None:
+        op = subject[len(_API_PREFIX) :]
+        try:
+            body = json.loads(payload) if payload.strip() else {}
+        except ValueError:
+            await self._reply_error(reply, 400, "bad request payload")
+            return
+        try:
+            if op.startswith("STREAM.CREATE.") or op.startswith("STREAM.UPDATE."):
+                await self._stream_create(op.rsplit(".", 1)[1], body, reply)
+            elif op.startswith("STREAM.INFO."):
+                await self._stream_info(op.rsplit(".", 1)[1], reply)
+            elif op.startswith("STREAM.DELETE."):
+                await self._stream_delete(op.rsplit(".", 1)[1], reply)
+            elif op.startswith("STREAM.PURGE."):
+                await self._stream_purge(op.rsplit(".", 1)[1], body, reply)
+            elif op == "STREAM.NAMES":
+                names = sorted(self.streams)
+                await self._reply_json(
+                    reply, {"streams": names, "total": len(names), "offset": 0, "limit": 1024}
+                )
+            elif op.startswith("DIRECT.GET."):
+                await self._direct_get(op[len("DIRECT.GET.") :], body, reply)
+            else:
+                await self._reply_error(reply, 404, f"unknown JS API op {op}")
+        except Exception as e:  # noqa: BLE001 — API errors become error replies
+            log.exception("JS API error on %s", subject)
+            await self._reply_error(reply, 500, str(e))
+
+    async def _stream_create(self, name: str, config: dict, reply) -> None:
+        existing = self.streams.get(name)
+        if existing is None:
+            config = dict(config or {})
+            config.setdefault("name", name)
+            config.setdefault("subjects", [name])
+            self.streams[name] = _Stream(name=name, config=config)
+            self._persist_rewrite(self.streams[name])
+        else:
+            existing.config.update(config or {})
+        await self._stream_info(name, reply)
+
+    def _state(self, st: _Stream) -> dict:
+        return {
+            "messages": len(st.msgs),
+            "bytes": st.bytes_total(),
+            "first_seq": st.msgs[0].seq if st.msgs else 0,
+            "last_seq": st.msgs[-1].seq if st.msgs else st.next_seq - 1,
+            "num_subjects": len({m.subject for m in st.msgs}),
+        }
+
+    async def _stream_info(self, name: str, reply) -> None:
+        st = self.streams.get(name)
+        if st is None:
+            await self._reply_error(reply, 404, "stream not found")
+            return
+        await self._reply_json(
+            reply,
+            {"type": "io.nats.jetstream.api.v1.stream_info_response",
+             "config": st.config, "state": self._state(st), "created": ""},
+        )
+
+    async def _stream_delete(self, name: str, reply) -> None:
+        st = self.streams.pop(name, None)
+        if st is None:
+            await self._reply_error(reply, 404, "stream not found")
+            return
+        if self.store_dir:
+            self._stream_file(name).unlink(missing_ok=True)
+        await self._reply_json(reply, {"success": True})
+
+    async def _stream_purge(self, name: str, body: dict, reply) -> None:
+        st = self.streams.get(name)
+        if st is None:
+            await self._reply_error(reply, 404, "stream not found")
+            return
+        filt = body.get("filter")
+        before = len(st.msgs)
+        if filt:
+            st.msgs = [m for m in st.msgs if not subject_matches(filt, m.subject)]
+        else:
+            st.msgs.clear()
+        self._persist_rewrite(st)
+        await self._reply_json(reply, {"success": True, "purged": before - len(st.msgs)})
+
+    async def _direct_get(self, stream_name: str, body: dict, reply) -> None:
+        st = self.streams.get(stream_name)
+        if reply is None:
+            return
+        if st is None:
+            await self.broker.publish_internal(
+                reply, b"", headers={"Status": "404", "Description": "Stream Not Found"}
+            )
+            return
+        msg: _StoredMsg | None = None
+        if "last_by_subj" in body:
+            pat = body["last_by_subj"]
+            for m in reversed(st.msgs):
+                if subject_matches(pat, m.subject):
+                    msg = m
+                    break
+        else:
+            seq = int(body.get("seq") or 0)
+            pat = body.get("next_by_subj")
+            for m in st.msgs:
+                if m.seq >= seq and (pat is None or subject_matches(pat, m.subject)):
+                    msg = m
+                    break
+        if msg is None:
+            await self.broker.publish_internal(
+                reply, b"", headers={"Status": "404", "Description": "Message Not Found"}
+            )
+            return
+        hdrs = dict(msg.headers or {})
+        hdrs.update(
+            {
+                "Nats-Stream": st.name,
+                "Nats-Subject": msg.subject,
+                "Nats-Sequence": str(msg.seq),
+                "Nats-Num-Pending": "0",
+            }
+        )
+        await self.broker.publish_internal(reply, msg.payload, headers=hdrs)
+
+
+__all__ = ["JetStreamStoreModule"]

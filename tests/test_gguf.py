@@ -331,3 +331,34 @@ def test_from_metadata():
     assert tok.vocab_size == 5
     assert tok.bos_id == 1
     assert tok.encode("x")[0] == 1
+
+
+@pytest.mark.parametrize("ttype", [GGMLType.Q4_K, GGMLType.Q5_K])
+def test_kquant_positive_offset_data(ttype):
+    """Sub-blocks with a positive minimum (biases, norm weights near 1.0)
+    must survive the affine encoding, whose offset term is non-positive."""
+    x = np.full(256, 5.0, dtype=np.float32)
+    y = dequantize(quantize(x, ttype), ttype, x.size)
+    np.testing.assert_allclose(y, x, rtol=0.02)
+    x2 = RNG.uniform(5.0, 5.01, 256).astype(np.float32)
+    y2 = dequantize(quantize(x2, ttype), ttype, x2.size)
+    assert np.abs(y2 - x2).max() < 0.05
+
+
+def test_tokenizer_rejects_unknown_model():
+    with pytest.raises(NotImplementedError):
+        GGUFTokenizer(model="bert", tokens=["a"])
+
+
+def test_spm_unk_fallback_without_byte_tokens():
+    tokens = ["<unk>", "▁", "a", "b"]
+    tok = GGUFTokenizer(
+        model="llama",
+        tokens=tokens,
+        scores=[0.0, -1.0, -1.0, -1.0],
+        token_types=[int(TokenType.UNKNOWN)] + [int(TokenType.NORMAL)] * 3,
+        add_bos=False,
+    )
+    ids = tok.encode("aé")  # é has no byte tokens -> unk per SentencePiece
+    assert tok.unk_id == 0
+    assert 0 in ids and tok.vocab["a"] in ids

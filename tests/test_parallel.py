@@ -1,0 +1,104 @@
+"""Sharding tests on the 8-way virtual CPU mesh (SURVEY.md §4.3-4.4): mesh
+spec parsing, TP/DP/EP-sharded forward matching the unsharded reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+from nats_llm_studio_tpu.parallel import build_mesh, parse_mesh_spec, shard_cache, shard_params
+from nats_llm_studio_tpu.parallel.sharding import validate_mesh_for_config
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("tp=8") == {"tp": 8}
+    assert parse_mesh_spec("tp=4,dp=2") == {"dp": 2, "tp": 4}  # normalized order
+    assert parse_mesh_spec("") == {}
+    assert parse_mesh_spec("auto") == {}
+    with pytest.raises(ValueError):
+        parse_mesh_spec("zz=4")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("tp=0")
+
+
+def test_build_mesh_validates_device_count():
+    assert build_mesh("tp=8").shape == {"tp": 8}
+    assert dict(build_mesh("dp=2,tp=4").shape) == {"dp": 2, "tp": 4}
+    assert build_mesh("").shape == {"tp": 8}
+    with pytest.raises(ValueError):
+        build_mesh("tp=3")
+
+
+def test_validate_mesh_for_config():
+    mesh = build_mesh("tp=8")
+    validate_mesh_for_config(mesh, ModelConfig.tiny(n_heads=8, n_kv_heads=8, d_ff=128))
+    with pytest.raises(ValueError):
+        validate_mesh_for_config(mesh, ModelConfig.tiny(n_heads=6, n_kv_heads=2))
+
+
+def _run(cfg, params, k, v, tokens):
+    logits, k, v = forward(params, cfg, tokens, k, v, jnp.zeros((tokens.shape[0],), jnp.int32))
+    return np.asarray(logits), k, v
+
+
+@pytest.mark.parametrize("spec", ["tp=8", "dp=2,tp=4"])
+def test_sharded_forward_matches_unsharded(spec):
+    cfg = ModelConfig.tiny(n_heads=8, n_kv_heads=8, head_dim=8, d_model=64, d_ff=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+
+    k, v = make_cache(cfg, 2, 16)
+    ref, _, _ = _run(cfg, params, k, v, tokens)
+
+    mesh = build_mesh(spec)
+    validate_mesh_for_config(mesh, cfg)
+    sp = shard_params(params, mesh)
+    k, v = make_cache(cfg, 2, 16)
+    k, v = shard_cache(k, v, mesh)
+    got, k2, v2 = _run(cfg, sp, k, v, tokens)
+
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+    # cache written identically under sharding
+    k_ref, v_ref = make_cache(cfg, 2, 16)
+    _, k_ref, v_ref = forward(params, cfg, tokens, k_ref, v_ref, jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(k2), np.asarray(k_ref), rtol=2e-3, atol=2e-3)
+
+
+def test_moe_expert_parallel_matches():
+    cfg = ModelConfig.tiny(
+        n_heads=4, n_kv_heads=4, head_dim=8, d_model=32, d_ff=64, n_experts=4, n_experts_used=2
+    )
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    tokens = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+
+    k, v = make_cache(cfg, 2, 8)
+    ref, _, _ = _run(cfg, params, k, v, tokens)
+
+    mesh = build_mesh("dp=2,ep=4")
+    validate_mesh_for_config(mesh, cfg)
+    sp = shard_params(params, mesh)
+    k, v = make_cache(cfg, 2, 8)
+    k, v = shard_cache(k, v, mesh)
+    got, _, _ = _run(cfg, sp, k, v, tokens)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_sharded_decode_consistency():
+    """Prefill + decode under TP matches unsharded full prefill."""
+    cfg = ModelConfig.tiny(n_heads=8, n_kv_heads=8, head_dim=8, d_model=64, d_ff=128)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    seq = [1, 2, 3, 4, 5]
+    full = jnp.asarray([seq], jnp.int32)
+
+    k, v = make_cache(cfg, 1, 16)
+    ref, _, _ = _run(cfg, params, k, v, full)
+
+    mesh = build_mesh("tp=8")
+    sp = shard_params(params, mesh)
+    k, v = shard_cache(*make_cache(cfg, 1, 16), mesh)
+    logits, k, v = forward(params, cfg, full[:, :3], k, v, jnp.zeros((1,), jnp.int32))
+    for t in range(3, 5):
+        logits, k, v = forward(sp, cfg, full[:, t : t + 1], k, v, jnp.full((1,), t, jnp.int32))
+        np.testing.assert_allclose(np.asarray(logits[0, 0]), ref[0, t], rtol=2e-3, atol=2e-3)

@@ -1,0 +1,202 @@
+"""End-to-end: real (tiny random-weight) model served over real embedded NATS
+— the reference's full capability surface in one flow: publish to Object
+Store, pull_model, list_models, chat_model (plain + streaming), delete_model
+(SURVEY.md §4.2 + §7 minimum slice)."""
+
+import json
+
+import jax
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.gguf.constants import TokenType
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.export import export_params_to_gguf
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.registry import LocalRegistry
+from nats_llm_studio_tpu.store import JetStreamStoreModule, ModelStore
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+from nats_llm_studio_tpu.transport.jetstream import ObjectStore
+
+from conftest import async_test
+
+
+def byte_level_tokenizer_md(vocab_size: int) -> dict:
+    """gpt2-family tokenizer covering all bytes (any text encodes), padded to
+    the model vocab; last id is the eos/control token."""
+    from nats_llm_studio_tpu.gguf.tokenizer import _byte_to_unicode
+
+    b2u = _byte_to_unicode()
+    tokens = [b2u[b] for b in range(256)]
+    while len(tokens) < vocab_size - 1:
+        tokens.append(f"<filler_{len(tokens)}>")
+    tokens.append("<|eot|>")
+    types = [int(TokenType.NORMAL)] * (vocab_size - 1) + [int(TokenType.CONTROL)]
+    return {
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.token_type": types,
+        "tokenizer.ggml.merges": [],
+        "tokenizer.ggml.eos_token_id": vocab_size - 1,
+        "tokenizer.ggml.add_bos_token": False,
+    }
+
+
+def build_tiny_gguf(path):
+    cfg = ModelConfig.tiny(vocab_size=300, n_layers=2, max_seq_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    export_params_to_gguf(
+        path, params, cfg, tokenizer_md=byte_level_tokenizer_md(300), name="tiny-e2e"
+    )
+    return cfg
+
+
+class E2E:
+    async def __aenter__(self):
+        self.broker = await EmbeddedBroker().start()
+        JetStreamStoreModule(self.broker).install()
+        self.nc = await connect(self.broker.url)
+        self.objstore = ObjectStore(self.nc, timeout=5.0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.nc.close()
+        await self.broker.stop()
+
+    async def req(self, op, payload, timeout=50.0):
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        msg = await self.nc.request(f"lmstudio.{op}", body, timeout=timeout)
+        return json.loads(msg.payload)
+
+
+@async_test
+async def test_full_model_lifecycle_over_nats(tmp_path):
+    async with E2E() as h:
+        # publisher side: export + upload the model to the bucket
+        src = tmp_path / "tiny.gguf"
+        build_tiny_gguf(src)
+        pub_store = ModelStore(tmp_path / "publisher", objstore=h.objstore)
+        pub_store.import_file(src, "acme/tiny-e2e")
+        await pub_store.publish_model("acme/tiny-e2e")
+
+        # worker side: empty cache, object store-backed registry
+        worker_store = ModelStore(tmp_path / "worker", objstore=h.objstore)
+        registry = LocalRegistry(worker_store, dtype="float32")
+        worker = Worker(WorkerConfig(nats_url=h.broker.url), registry)
+        await worker.start()
+
+        # 1. pull_model from the bucket (lms get analog)
+        resp = await h.req("pull_model", {"identifier": "acme/tiny-e2e"})
+        assert resp["ok"], resp
+        assert "tiny.gguf" in resp["data"]["output"]
+
+        # 2. list_models: cached, not loaded
+        resp = await h.req("list_models", {})
+        entries = resp["data"]["models"]["data"]
+        assert [e["id"] for e in entries] == ["acme/tiny-e2e"]
+        assert entries[0]["state"] == "not-loaded"
+
+        # 3. chat_model: real forward pass + sampling + detokenize
+        resp = await h.req(
+            "chat_model",
+            {
+                "model": "acme/tiny-e2e",
+                "messages": [{"role": "user", "content": "hi there"}],
+                "max_tokens": 6,
+                "temperature": 0.0,
+            },
+        )
+        assert resp["ok"], resp
+        body = resp["data"]["response"]
+        assert body["object"] == "chat.completion"
+        assert body["usage"]["completion_tokens"] >= 1
+        assert isinstance(body["choices"][0]["message"]["content"], str)
+        assert "stats" in body  # tok/s + ttft observability
+
+        # greedy determinism end-to-end
+        resp2 = await h.req(
+            "chat_model",
+            {
+                "model": "acme/tiny-e2e",
+                "messages": [{"role": "user", "content": "hi there"}],
+                "max_tokens": 6,
+                "temperature": 0.0,
+            },
+        )
+        assert (
+            resp2["data"]["response"]["choices"][0]["message"]["content"]
+            == body["choices"][0]["message"]["content"]
+        )
+
+        # 4. list_models now shows loaded
+        resp = await h.req("list_models", {})
+        assert resp["data"]["models"]["data"][0]["state"] == "loaded"
+
+        # 5. streaming: chunks then terminal aggregate with usage
+        chunks = []
+        final = None
+        async for msg in h.nc.request_stream(
+            "lmstudio.chat_model",
+            json.dumps(
+                {
+                    "model": "acme/tiny-e2e",
+                    "stream": True,
+                    "messages": [{"role": "user", "content": "stream me"}],
+                    "max_tokens": 5,
+                    "temperature": 0.0,
+                }
+            ).encode(),
+            timeout=50.0,
+        ):
+            body = json.loads(msg.payload)
+            if (msg.headers or {}).get("Nats-Stream-Done"):
+                final = body
+                break
+            chunks.append(body["data"]["chunk"])
+        assert final is not None and final["ok"], final
+        streamed = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        agg = final["data"]["response"]["choices"][0]["message"]["content"]
+        assert streamed == agg
+
+        # 6. health reflects engine registry
+        resp = await h.req("health", {})
+        assert resp["data"]["models_loaded"] == 1
+
+        # 7. delete_model unloads + removes the cache dir
+        resp = await h.req("delete_model", {"model_id": "acme/tiny-e2e"})
+        assert resp["ok"], resp
+        assert "acme" in resp["data"]["deleted_dir"]
+        resp = await h.req("list_models", {})
+        assert resp["data"]["models"]["data"] == []
+
+        # 8. chat after delete -> model not found error envelope
+        resp = await h.req(
+            "chat_model", {"model": "acme/tiny-e2e", "messages": [{"role": "user", "content": "x"}]}
+        )
+        assert not resp["ok"] and "not found" in resp["error"]
+
+        await worker.drain()
+
+
+@async_test
+async def test_sync_model_from_bucket_subject_real_store(tmp_path):
+    """The conceptual fifth subject (README.md:286-318) made real."""
+    async with E2E() as h:
+        src = tmp_path / "m.gguf"
+        build_tiny_gguf(src)
+        pub = ModelStore(tmp_path / "pub", objstore=h.objstore)
+        pub.import_file(src, "acme/sync-model")
+        await pub.publish_model("acme/sync-model")
+
+        worker_store = ModelStore(tmp_path / "worker", objstore=h.objstore)
+        worker = Worker(WorkerConfig(nats_url=h.broker.url), LocalRegistry(worker_store))
+        await worker.start()
+        resp = await h.req(
+            "sync_model_from_bucket", {"object_name": "acme/sync-model/m.gguf"}
+        )
+        assert resp["ok"], resp
+        assert resp["data"]["local_path"].endswith("m.gguf")
+        assert worker_store.lookup("acme/sync-model") is not None
+        await worker.drain()

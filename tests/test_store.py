@@ -1,0 +1,163 @@
+"""Object store + model manager tests (SURVEY.md §4.2: Object Store
+round-trip over real embedded NATS)."""
+
+import asyncio
+
+import pytest
+
+from nats_llm_studio_tpu.store import JetStreamStoreModule, ModelStore
+from nats_llm_studio_tpu.store.manager import StoreError, split_model_id
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+from nats_llm_studio_tpu.transport.jetstream import ObjectNotFound, ObjectStore
+
+from conftest import async_test
+
+
+class JsHarness:
+    def __init__(self, store_dir=None):
+        self.store_dir = store_dir
+
+    async def __aenter__(self):
+        self.broker = await EmbeddedBroker().start()
+        self.module = JetStreamStoreModule(self.broker, store_dir=self.store_dir).install()
+        self.nc = await connect(self.broker.url)
+        self.os = ObjectStore(self.nc, timeout=5.0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.nc.close()
+        await self.broker.stop()
+
+
+@async_test
+async def test_put_get_roundtrip_multichunk():
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("llm-models")
+        blob = bytes(range(256)) * 2000  # 512000 bytes -> 4 chunks at 128k
+        info = await h.os.put("llm-models", "pub/model/weights.gguf", blob)
+        assert info.chunks == 4
+        assert info.size == len(blob)
+        got = await h.os.get("llm-models", "pub/model/weights.gguf")
+        assert got == blob
+
+
+@async_test
+async def test_small_and_empty_objects():
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("b")
+        await h.os.put("b", "tiny", b"x")
+        assert await h.os.get("b", "tiny") == b"x"
+        await h.os.put("b", "empty", b"")
+        assert await h.os.get("b", "empty") == b""
+
+
+@async_test
+async def test_overwrite_uses_rollup():
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("b")
+        await h.os.put("b", "obj", b"version-1")
+        await h.os.put("b", "obj", b"version-2-longer")
+        assert await h.os.get("b", "obj") == b"version-2-longer"
+        infos = await h.os.list("b")
+        assert [i.name for i in infos] == ["obj"]
+
+
+@async_test
+async def test_list_and_delete():
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("b")
+        await h.os.put("b", "a/model/x.gguf", b"aaa")
+        await h.os.put("b", "c/model/y.gguf", b"ccc")
+        names = {i.name for i in await h.os.list("b")}
+        assert names == {"a/model/x.gguf", "c/model/y.gguf"}
+        await h.os.delete("b", "a/model/x.gguf")
+        names = {i.name for i in await h.os.list("b")}
+        assert names == {"c/model/y.gguf"}
+        with pytest.raises(ObjectNotFound):
+            await h.os.get("b", "a/model/x.gguf")
+
+
+@async_test
+async def test_missing_object_and_bucket():
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("b")
+        with pytest.raises(ObjectNotFound):
+            await h.os.info("b", "nope")
+        with pytest.raises(ObjectNotFound):
+            await h.os.get("missing-bucket", "nope")
+        assert await h.os.list_buckets() == ["b"]
+
+
+@async_test
+async def test_persistence_across_restart(tmp_path):
+    store_dir = tmp_path / "js"
+    async with JsHarness(store_dir=store_dir) as h:
+        await h.os.ensure_bucket("b")
+        await h.os.put("b", "persisted", b"DATA" * 1000)
+    # new broker + module over the same store dir
+    async with JsHarness(store_dir=store_dir) as h2:
+        got = await h2.os.get("b", "persisted")
+        assert got == b"DATA" * 1000
+
+
+# ---------------------------------------------------------------------------
+# ModelStore
+# ---------------------------------------------------------------------------
+
+
+def test_split_model_id():
+    assert split_model_id("meta/llama-3-8b") == ("meta", "llama-3-8b")
+    assert split_model_id("bare-model") == ("local", "bare-model")
+    assert split_model_id("/p/m/") == ("p", "m")
+
+
+def test_local_cache_lifecycle(tmp_path):
+    ms = ModelStore(tmp_path / "models")
+    src = tmp_path / "w.gguf"
+    src.write_bytes(b"GGUFDATA")
+    dest = ms.import_file(src, "pub/mymodel")
+    assert dest.read_bytes() == b"GGUFDATA"
+    cached = ms.cached()
+    assert [c.model_id for c in cached] == ["pub/mymodel"]
+    assert ms.lookup("pub/mymodel").gguf_path == dest
+    deleted = ms.delete_local("pub/mymodel")
+    assert "pub" in deleted and "mymodel" in deleted
+    assert ms.cached() == []
+    with pytest.raises(StoreError) as ei:
+        ms.delete_local("pub/mymodel")
+    assert ei.value.dir is not None  # attempted dir carried for the envelope
+
+
+@async_test
+async def test_publish_and_pull_roundtrip(tmp_path):
+    async with JsHarness() as h:
+        ms_a = ModelStore(tmp_path / "worker_a", objstore=h.os)
+        ms_b = ModelStore(tmp_path / "worker_b", objstore=h.os)
+        src = tmp_path / "model.gguf"
+        src.write_bytes(b"WEIGHTS" * 5000)
+        ms_a.import_file(src, "acme/granite-tiny")
+        obj = await ms_a.publish_model("acme/granite-tiny")
+        assert obj == "acme/granite-tiny/model.gguf"
+        # second worker pulls by model id
+        path, transcript = await ms_b.pull("acme/granite-tiny")
+        assert path.read_bytes() == src.read_bytes()
+        assert "resolved to object" in transcript
+        assert ms_b.lookup("acme/granite-tiny") is not None
+        # and by full object name
+        path2, _ = await ms_b.pull("acme/granite-tiny/model.gguf")
+        assert path2 == path
+
+
+@async_test
+async def test_pull_missing_raises(tmp_path):
+    async with JsHarness() as h:
+        ms = ModelStore(tmp_path / "m", objstore=h.os)
+        await h.os.ensure_bucket("llm-models")
+        with pytest.raises(StoreError):
+            await ms.pull("ghost/model")
+
+
+def test_pull_requires_objstore(tmp_path):
+    ms = ModelStore(tmp_path / "m")
+    with pytest.raises(StoreError):
+        asyncio.run(ms.pull("a/b"))
