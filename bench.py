@@ -42,7 +42,7 @@ def main() -> None:
     else:
         from __graft_entry__ import GRANITE_2B
 
-        cfg = GRANITE_2B
+        cfg = GRANITE_2B.with_(use_flash_attention=jax.default_backend() == "tpu")
         batch = int(os.environ.get("BENCH_BATCH", "8"))
         prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
         seq_len = int(os.environ.get("BENCH_SEQ", "1024"))

@@ -11,16 +11,9 @@ import jax
 import jax.numpy as jnp
 
 
-def sample(
-    logits: jax.Array,  # [B, V] f32
-    key: jax.Array,
-    temperature: jax.Array | float = 0.8,
-    top_k: jax.Array | int = 0,  # 0 = disabled
-    top_p: jax.Array | float = 1.0,
-) -> jax.Array:
-    """Returns sampled token ids [B] int32. temperature <= 0 means greedy
-    (per row). One sort of the vocab per call; masks are rank-based so top-k
-    and top-p are per-row arrays, not static."""
+def _masked_scaled(logits, temperature, top_k, top_p):
+    """Shared top-k/top-p masking. Returns (masked/temp logits in sorted
+    order, sorted_idx, temperature)."""
     b, v = logits.shape
     temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
@@ -39,8 +32,49 @@ def sample(
     cum = jnp.cumsum(probs, axis=-1)
     keep &= (cum - probs) < top_p[:, None]
 
-    masked = jnp.where(keep, sorted_logits, -jnp.inf)
-    drawn = jax.random.categorical(key, masked / safe_t, axis=-1)  # index into sorted order
+    masked = jnp.where(keep, sorted_logits, -jnp.inf) / safe_t
+    return masked, sorted_idx, temperature
+
+
+def _pick(masked, sorted_idx, temperature, gumbel) -> jax.Array:
+    drawn = jnp.argmax(masked + gumbel, axis=-1)
     sampled = jnp.take_along_axis(sorted_idx, drawn[:, None], axis=-1)[:, 0]
     greedy = sorted_idx[:, 0]
     return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    temperature: jax.Array | float = 0.8,
+    top_k: jax.Array | int = 0,  # 0 = disabled
+    top_p: jax.Array | float = 1.0,
+) -> jax.Array:
+    """Returns sampled token ids [B] int32. temperature <= 0 means greedy
+    (per row). One sort of the vocab per call; masks are rank-based so top-k
+    and top-p are per-row arrays, not static."""
+    masked, sorted_idx, temperature = _masked_scaled(logits, temperature, top_k, top_p)
+    gumbel = jax.random.gumbel(key, masked.shape, jnp.float32)
+    return _pick(masked, sorted_idx, temperature, gumbel)
+
+
+def sample_rows(
+    logits: jax.Array,  # [B, V] f32
+    seeds: jax.Array,  # [B] int32 — per-row PRNG seed
+    steps: jax.Array,  # [B] int32 — per-row step counter
+    temperature: jax.Array | float = 0.8,
+    top_k: jax.Array | int = 0,
+    top_p: jax.Array | float = 1.0,
+) -> jax.Array:
+    """Per-row deterministic sampling: row i's randomness depends only on
+    (seeds[i], steps[i]), never on batch composition — a request replayed
+    with the same seed reproduces its completion regardless of what else is
+    running in the continuous batch."""
+    masked, sorted_idx, temperature = _masked_scaled(logits, temperature, top_k, top_p)
+
+    def row_gumbel(seed, step):
+        k = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.gumbel(k, (logits.shape[1],), jnp.float32)
+
+    gumbel = jax.vmap(row_gumbel)(seeds, steps)
+    return _pick(masked, sorted_idx, temperature, gumbel)

@@ -72,6 +72,12 @@ def dequantize(data: bytes | np.ndarray, t: GGMLType, n_elements: int) -> np.nda
     fn = _DEQUANT.get(t)
     if fn is None:
         raise NotImplementedError(f"dequantize: {t.name} not supported")
+    if n_elements >= 4096:  # ctypes call overhead isn't worth it for tiny tensors
+        from ..native import dequantize_native
+
+        out = dequantize_native(data, int(t), n_elements)
+        if out is not None:
+            return out
     return fn(_blocks(data, t, n_elements)).reshape(-1)
 
 

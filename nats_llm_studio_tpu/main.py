@@ -1,0 +1,197 @@
+"""Worker process entrypoint.
+
+The reference is a library with no ``main()`` — its README tells embedders to
+wire config/connect/subscribe themselves (SURVEY.md §1 "critical structural
+fact"). This CLI is that wiring, made first-class:
+
+    python -m nats_llm_studio_tpu serve            # worker against NATS_URL
+    python -m nats_llm_studio_tpu serve --embedded-broker [--port 4222]
+    python -m nats_llm_studio_tpu broker --port 4222 [--store-dir ./nats_data]
+    python -m nats_llm_studio_tpu publish <model.gguf> <publisher>/<name>
+    python -m nats_llm_studio_tpu chat <model_id> "prompt..."
+
+Env contract (reference README.md:489-494, minus the LM Studio URL):
+NATS_URL, LMSTUDIO_MODELS_DIR, NATS_QUEUE_GROUP, plus TPU_MESH,
+MAX_BATCH_SLOTS, MAX_SEQ_LEN. Multi-host meshes initialize through
+``jax.distributed`` when JAX_COORDINATOR_ADDRESS is set.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+from .config import WorkerConfig
+
+log = logging.getLogger("nats_llm_studio_tpu")
+
+
+def _maybe_init_distributed() -> None:
+    """Join a multi-host DCN mesh when coordinator env vars are present
+    (SURVEY.md §5 distributed-backend: jax.distributed + PJRT over DCN)."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+    log.info("joined distributed mesh: %d devices", len(jax.devices()))
+
+
+async def _run_serve(args: argparse.Namespace) -> None:
+    from .serve import Worker
+    from .serve.registry import LocalRegistry
+    from .store import JetStreamStoreModule, ModelStore
+    from .transport import EmbeddedBroker, connect
+    from .transport.jetstream import ObjectStore
+
+    cfg = WorkerConfig()
+    broker = None
+    if args.embedded_broker:
+        broker = await EmbeddedBroker(port=args.port).start()
+        JetStreamStoreModule(broker, store_dir=args.store_dir).install()
+        cfg.nats_url = broker.url
+        log.info("embedded broker on %s", broker.url)
+
+    _maybe_init_distributed()
+    mesh = None
+    if cfg.mesh_shape:
+        from .parallel import build_mesh
+
+        mesh = build_mesh(cfg.mesh_shape)
+        log.info("mesh: %s", dict(mesh.shape))
+
+    nc = await connect(cfg.nats_url, name="store-client")
+    store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket)
+    registry = LocalRegistry(
+        store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots
+    )
+    worker = Worker(cfg, registry)
+    await worker.start()
+    log.info("worker serving %s.* on %s (models: %s)", cfg.subject_prefix, cfg.nats_url,
+             cfg.models_dir)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("draining...")
+    await worker.drain()
+    await nc.close()
+    if broker is not None:
+        await broker.stop()
+
+
+async def _run_broker(args: argparse.Namespace) -> None:
+    from .store import JetStreamStoreModule
+    from .transport import EmbeddedBroker
+
+    broker = await EmbeddedBroker(port=args.port).start()
+    JetStreamStoreModule(broker, store_dir=args.store_dir).install()
+    log.info("broker on %s (store: %s)", broker.url, args.store_dir or "memory")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await broker.stop()
+
+
+async def _run_publish(args: argparse.Namespace) -> None:
+    from .store import ModelStore
+    from .transport import connect
+    from .transport.jetstream import ObjectStore
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url)
+    store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket)
+    store.import_file(args.gguf, args.model_id)
+    obj = await store.publish_model(args.model_id)
+    print(f"published {obj} to bucket {cfg.bucket!r}")
+    await nc.close()
+
+
+async def _run_chat(args: argparse.Namespace) -> None:
+    from .transport import connect
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url)
+    payload = {
+        "model": args.model_id,
+        "messages": [{"role": "user", "content": args.prompt}],
+        "max_tokens": args.max_tokens,
+        "temperature": args.temperature,
+        "stream": args.stream,
+    }
+    body = json.dumps(payload).encode()
+    subject = cfg.subject("chat_model")
+    if args.stream:
+        async for msg in nc.request_stream(subject, body, timeout=cfg.chat_timeout_s):
+            r = json.loads(msg.payload)
+            if (msg.headers or {}).get("Nats-Stream-Done"):
+                if not r.get("ok"):
+                    print(f"\nerror: {r.get('error')}", file=sys.stderr)
+                print()
+                break
+            delta = r["data"]["chunk"]["choices"][0]["delta"].get("content", "")
+            print(delta, end="", flush=True)
+    else:
+        msg = await nc.request(subject, body, timeout=cfg.chat_timeout_s)
+        r = json.loads(msg.payload)
+        if not r.get("ok"):
+            print(f"error: {r.get('error')}", file=sys.stderr)
+            sys.exit(1)
+        print(r["data"]["response"]["choices"][0]["message"]["content"])
+    await nc.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    p = argparse.ArgumentParser(prog="nats-llm-studio-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("serve", help="run a TPU worker")
+    sp.add_argument("--embedded-broker", action="store_true")
+    sp.add_argument("--port", type=int, default=4222)
+    sp.add_argument("--store-dir", default=None)
+
+    bp = sub.add_parser("broker", help="run the embedded NATS broker + object store")
+    bp.add_argument("--port", type=int, default=4222)
+    bp.add_argument("--store-dir", default="./nats_data")
+
+    pp = sub.add_parser("publish", help="import a GGUF and upload it to the bucket")
+    pp.add_argument("gguf")
+    pp.add_argument("model_id")
+
+    cp = sub.add_parser("chat", help="send a chat request over NATS")
+    cp.add_argument("model_id")
+    cp.add_argument("prompt")
+    cp.add_argument("--max-tokens", type=int, default=256)
+    cp.add_argument("--temperature", type=float, default=0.8)
+    cp.add_argument("--stream", action="store_true")
+
+    args = p.parse_args(argv)
+    runner = {
+        "serve": _run_serve,
+        "broker": _run_broker,
+        "publish": _run_publish,
+        "chat": _run_chat,
+    }[args.cmd]
+    asyncio.run(runner(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,9 @@ class ModelConfig:
     attention_scale: float | None = None  # None -> 1/sqrt(head_dim)
     logit_scale: float = 1.0
     dtype: str = "bfloat16"  # compute/weight dtype name (tests use float32)
+    # Pallas flash-attention for prefill (requires prefill at start_pos 0,
+    # which the engine guarantees); decode keeps the fused XLA path
+    use_flash_attention: bool = False
 
     @property
     def attn_scale(self) -> float:

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.flash_attention import flash_attention_auto
 from ..ops.layers import apply_rope, gqa_attention, rms_norm, rope_cos_sin, swiglu
 from .config import ModelConfig
 
@@ -54,7 +55,14 @@ def _attention_block(
     k_cache = write(k_cache, k.astype(k_cache.dtype), start_pos)
     v_cache = write(v_cache, v.astype(v_cache.dtype), start_pos)
 
-    out = gqa_attention(q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask, cfg.attn_scale)
+    if cfg.use_flash_attention and t > 1:
+        # prefill at start_pos 0: the cache holds exactly k/v, so causal
+        # attention over the fresh block equals attention over the cache
+        out = flash_attention_auto(q, k, v, cfg.attn_scale)
+    else:
+        out = gqa_attention(
+            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask, cfg.attn_scale
+        )
     return out.reshape(b, t, hq * d) @ p["wo"], k_cache, v_cache
 
 

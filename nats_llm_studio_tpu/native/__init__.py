@@ -1,0 +1,108 @@
+"""ctypes bridge to the native dequant hot loop (dequant.cpp).
+
+Compiled lazily on first use with the system toolchain (g++ is part of the
+target environment; pybind11 is not, hence ctypes) and cached per source
+hash. Every entry point degrades to the NumPy reference in gguf/quants.py if
+the toolchain or build is unavailable, so the native layer is a pure
+accelerator, never a requirement.
+
+Disable with NATIVE_DEQUANT=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "dequant.cpp"
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+# ggml type id -> (exported symbol, block elems, block bytes)
+_FNS = {
+    8: ("dequant_q8_0", 32, 34),   # Q8_0
+    2: ("dequant_q4_0", 32, 18),   # Q4_0
+    12: ("dequant_q4_k", 256, 144),  # Q4_K
+    13: ("dequant_q5_k", 256, 176),  # Q5_K
+    14: ("dequant_q6_k", 256, 210),  # Q6_K
+}
+
+
+def _build() -> Path | None:
+    cache_dir = Path(os.environ.get("XDG_CACHE_HOME", Path.home() / ".cache")) / "nats-llm-studio-tpu"
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    so = cache_dir / f"dequant_{tag}.so"
+    if so.exists():
+        return so
+    with tempfile.NamedTemporaryFile(suffix=".so", dir=cache_dir, delete=False) as tmp:
+        tmp_path = Path(tmp.name)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(tmp_path), str(_SRC)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native dequant build failed (%s); using NumPy path", e)
+        tmp_path.unlink(missing_ok=True)
+        return None
+    tmp_path.replace(so)
+    return so
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("NATIVE_DEQUANT", "1") in ("0", "false"):
+        return None
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError as e:
+        log.warning("native dequant load failed (%s)", e)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    for sym, _, _ in _FNS.values():
+        fn = getattr(lib, sym)
+        fn.argtypes = [u8p, f32p, ctypes.c_int64]
+        fn.restype = None
+    lib.f16_to_f32_buf.argtypes = [ctypes.POINTER(ctypes.c_uint16), f32p, ctypes.c_int64]
+    lib.f16_to_f32_buf.restype = None
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def dequantize_native(data, ggml_type: int, n_elements: int) -> np.ndarray | None:
+    """Decode to float32, or None when this type/toolchain isn't covered."""
+    spec = _FNS.get(int(ggml_type))
+    lib = _load()
+    if spec is None or lib is None:
+        return None
+    sym, block_elems, block_bytes = spec
+    if n_elements % block_elems:
+        return None
+    nb = n_elements // block_elems
+    src = np.frombuffer(data, dtype=np.uint8, count=nb * block_bytes)
+    out = np.empty(n_elements, dtype=np.float32)
+    getattr(lib, sym)(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(nb),
+    )
+    return out

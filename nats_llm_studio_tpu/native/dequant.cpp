@@ -1,0 +1,185 @@
+// Native GGUF block-dequantization hot loop.
+//
+// SURVEY.md §2.2: the one genuinely native-worthy component — streaming a
+// 40 GB 70B GGUF into bf16 device shards is bottlenecked on block decode.
+// Bound via ctypes (no pybind11 in this environment); the NumPy path in
+// gguf/quants.py remains the reference implementation and fallback.
+//
+// Layouts follow the public GGML block formats (see gguf/quants.py for the
+// commented Python reference of each).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1Fu;
+    uint32_t man = h & 0x3FFu;
+    uint32_t bits;
+    if (exp == 0) {
+        if (man == 0) {
+            bits = sign;
+        } else {  // subnormal half -> normalized float
+            int e = 0;
+            while (!(man & 0x400u)) {
+                man <<= 1;
+                e++;
+            }
+            man &= 0x3FFu;
+            bits = sign | ((uint32_t)(113 - e) << 23) | (man << 13);
+        }
+    } else if (exp == 31) {
+        bits = sign | 0x7F800000u | (man << 13);  // inf / nan
+    } else {
+        bits = sign | ((exp + 112u) << 23) | (man << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+    uint32_t u;
+    std::memcpy(&u, &f, 4);
+    uint32_t rounded = (u + 0x7FFFu + ((u >> 16) & 1u)) >> 16;  // round-nearest-even
+    return (uint16_t)rounded;
+}
+
+// unpack the 12-byte packed 6-bit (scale, min) pairs of Q4_K/Q5_K
+inline void kquant_scales(const uint8_t* s, uint8_t* sc, uint8_t* m) {
+    for (int j = 0; j < 4; j++) {
+        sc[j] = s[j] & 63;
+        m[j] = s[j + 4] & 63;
+    }
+    for (int j = 4; j < 8; j++) {
+        sc[j] = (uint8_t)((s[j + 4] & 0x0F) | ((s[j - 4] >> 6) << 4));
+        m[j] = (uint8_t)((s[j + 4] >> 4) | ((s[j] >> 6) << 4));
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+void dequant_q8_0(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t i = 0; i < nb; i++) {
+        const uint8_t* b = in + i * 34;
+        uint16_t dh;
+        std::memcpy(&dh, b, 2);
+        const float d = f16_to_f32(dh);
+        const int8_t* q = (const int8_t*)(b + 2);
+        float* o = out + i * 32;
+        for (int j = 0; j < 32; j++) o[j] = d * (float)q[j];
+    }
+}
+
+void dequant_q4_0(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t i = 0; i < nb; i++) {
+        const uint8_t* b = in + i * 18;
+        uint16_t dh;
+        std::memcpy(&dh, b, 2);
+        const float d = f16_to_f32(dh);
+        const uint8_t* q = b + 2;
+        float* o = out + i * 32;
+        for (int j = 0; j < 16; j++) {
+            o[j] = d * (float)((int)(q[j] & 0x0F) - 8);
+            o[j + 16] = d * (float)((int)(q[j] >> 4) - 8);
+        }
+    }
+}
+
+void dequant_q4_k(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t i = 0; i < nb; i++) {
+        const uint8_t* b = in + i * 144;
+        uint16_t dh, mh;
+        std::memcpy(&dh, b, 2);
+        std::memcpy(&mh, b + 2, 2);
+        const float d = f16_to_f32(dh);
+        const float dmin = f16_to_f32(mh);
+        uint8_t sc[8], mn[8];
+        kquant_scales(b + 4, sc, mn);
+        const uint8_t* q = b + 16;
+        float* o = out + i * 256;
+        for (int c = 0; c < 4; c++) {  // chunk c: sub-blocks 2c (lo), 2c+1 (hi)
+            const float d1 = d * sc[2 * c], m1 = dmin * mn[2 * c];
+            const float d2 = d * sc[2 * c + 1], m2 = dmin * mn[2 * c + 1];
+            const uint8_t* qc = q + 32 * c;
+            float* oc = o + 64 * c;
+            for (int l = 0; l < 32; l++) {
+                oc[l] = d1 * (float)(qc[l] & 0x0F) - m1;
+                oc[l + 32] = d2 * (float)(qc[l] >> 4) - m2;
+            }
+        }
+    }
+}
+
+void dequant_q5_k(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t i = 0; i < nb; i++) {
+        const uint8_t* b = in + i * 176;
+        uint16_t dh, mh;
+        std::memcpy(&dh, b, 2);
+        std::memcpy(&mh, b + 2, 2);
+        const float d = f16_to_f32(dh);
+        const float dmin = f16_to_f32(mh);
+        uint8_t sc[8], mn[8];
+        kquant_scales(b + 4, sc, mn);
+        const uint8_t* qh = b + 16;
+        const uint8_t* ql = b + 48;
+        float* o = out + i * 256;
+        for (int c = 0; c < 4; c++) {
+            const float d1 = d * sc[2 * c], m1 = dmin * mn[2 * c];
+            const float d2 = d * sc[2 * c + 1], m2 = dmin * mn[2 * c + 1];
+            const uint8_t* qc = ql + 32 * c;
+            const uint8_t u1 = (uint8_t)(1u << (2 * c)), u2 = (uint8_t)(1u << (2 * c + 1));
+            float* oc = o + 64 * c;
+            for (int l = 0; l < 32; l++) {
+                oc[l] = d1 * (float)((qc[l] & 0x0F) + ((qh[l] & u1) ? 16 : 0)) - m1;
+                oc[l + 32] = d2 * (float)((qc[l] >> 4) + ((qh[l] & u2) ? 16 : 0)) - m2;
+            }
+        }
+    }
+}
+
+void dequant_q6_k(const uint8_t* in, float* out, int64_t nb) {
+    for (int64_t i = 0; i < nb; i++) {
+        const uint8_t* b = in + i * 210;
+        const uint8_t* ql = b;
+        const uint8_t* qh = b + 128;
+        const int8_t* sc = (const int8_t*)(b + 192);
+        uint16_t dh;
+        std::memcpy(&dh, b + 208, 2);
+        const float d = f16_to_f32(dh);
+        float* o = out + i * 256;
+        for (int h = 0; h < 2; h++) {
+            const uint8_t* qlh = ql + 64 * h;
+            const uint8_t* qhh = qh + 32 * h;
+            const int8_t* sch = sc + 8 * h;
+            float* oh = o + 128 * h;
+            for (int l = 0; l < 32; l++) {
+                const int is = l / 16;
+                const int q1 = (int)((qlh[l] & 0x0F) | (((qhh[l] >> 0) & 3) << 4)) - 32;
+                const int q2 = (int)((qlh[l + 32] & 0x0F) | (((qhh[l] >> 2) & 3) << 4)) - 32;
+                const int q3 = (int)((qlh[l] >> 4) | (((qhh[l] >> 4) & 3) << 4)) - 32;
+                const int q4 = (int)((qlh[l + 32] >> 4) | (((qhh[l] >> 6) & 3) << 4)) - 32;
+                oh[l] = d * sch[is] * (float)q1;
+                oh[l + 32] = d * sch[is + 2] * (float)q2;
+                oh[l + 64] = d * sch[is + 4] * (float)q3;
+                oh[l + 96] = d * sch[is + 6] * (float)q4;
+            }
+        }
+    }
+}
+
+void f16_to_f32_buf(const uint16_t* in, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) out[i] = f16_to_f32(in[i]);
+}
+
+// direct-to-bf16 variants: halve the host buffer for the 70B load path
+void f32_to_bf16_buf(const float* in, uint16_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) out[i] = f32_to_bf16(in[i]);
+}
+
+}  // extern "C"

@@ -1,0 +1,120 @@
+"""Causal flash attention (prefill) as a Pallas TPU kernel.
+
+SURVEY.md §7 hard part #1: prefill TTFT needs attention that never
+materializes the [T, S] score matrix in HBM. Online-softmax accumulation over
+key tiles keeps everything in VMEM; one grid cell per (batch, q-head,
+query-tile), with GQA folding (q head h reads kv head h // group).
+
+Used for prefill only (start_pos == 0, keys are the just-computed [B, T]
+block); decode keeps the fused XLA path, which is already memory-bound on
+weights, not attention. Falls back to interpreter mode off-TPU so tests run
+on the CPU backend (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_q: int, block_k: int):
+    # refs are [1, 1, T, D] blocks of the [B, H, T, D] layout (T and D in the
+    # last two positions to satisfy Mosaic's (8, 128) tiling rule)
+    qt = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [BQ, D]
+    d = q.shape[-1]
+    n_kv = k_ref.shape[2]
+
+    q_pos = qt * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kt, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        k_pos = kt * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    # causal: key tiles strictly after this query tile are fully masked
+    n_tiles = jnp.minimum((qt + 1) * block_q + block_k - 1, n_kv + block_k - 1) // block_k
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_tiles, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,  # [B, T, Hq, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,
+    scale: float,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal self-attention over a fresh [B, T] block. Returns q.dtype."""
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    block_q = min(block_q, max(t, 8))
+    block_k = min(block_k, max(t, 8))
+
+    pad_q = (-t) % block_q
+    pad_k = (-t) % block_k
+    if pad_q or pad_k:
+        # padded keys sit at positions >= t, which the causal mask removes
+        # for every real query; padded query rows are sliced away below
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    tq, tk = q.shape[1], k.shape[1]
+
+    # [B, H, T, D] layout: T/D in the trailing positions for Mosaic tiling
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+
+    grid = (b, hq, tq // block_q)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, tk, d), lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, tq, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.transpose(0, 2, 1, 3)[:, :t]
+
+
+def flash_attention_auto(q, k, v, scale: float) -> jax.Array:
+    """flash_attention with interpreter fallback off-TPU (tests on the CPU
+    backend run the same kernel logic through the Pallas interpreter)."""
+    interpret = jax.default_backend() != "tpu"
+    return flash_attention(q, k, v, scale, interpret=interpret)

@@ -1,0 +1,308 @@
+"""Continuous batcher: concurrent requests share one fixed-width decode step.
+
+SURVEY.md §7 puts this on the critical perf path (hard part #5): single-stream
+decode is HBM-bound on reading the weights once *per token*; batching B
+requests reads them once per B tokens. Design:
+
+* one decode program compiled at a fixed ``[B, 1]`` batch width (no shape
+  churn); empty slots run masked (token 0, pos 0, greedy) and are ignored
+* requests prefill into a single-row cache (bucketed lengths) and are
+  scattered into the shared ``[L, B, S, H, D]`` cache at their slot index —
+  joining and leaving never recompiles the decode step
+* one dedicated owner thread drives the device (the decode loop is the one
+  shared-mutable structure — SURVEY.md §5); asyncio callers talk to it
+  through thread-safe queues
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import queue as _queue
+import random
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import AsyncIterator
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.generator import SamplingParams, default_buckets
+from ..models.config import ModelConfig
+from ..models.llama import forward, make_cache
+from ..engine.sampling import sample_rows
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    prompt_ids: list[int]
+    sp: SamplingParams
+    loop: asyncio.AbstractEventLoop
+    out: asyncio.Queue  # (kind, value): ("tok", id) | ("end", reason) | ("err", exc)
+    slot: int = -1
+    pos: int = 0
+    generated: int = 0
+
+    def emit(self, kind: str, value) -> None:
+        self.loop.call_soon_threadsafe(self.out.put_nowait, (kind, value))
+
+
+@dataclass
+class BatcherStats:
+    requests: int = 0
+    tokens: int = 0
+    steps: int = 0
+    peak_active: int = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests,
+            "tokens": self.tokens,
+            "decode_steps": self.steps,
+            "peak_active_slots": self.peak_active,
+            "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
+        }
+
+
+class ContinuousBatcher:
+    """Owns the device loop for one loaded model."""
+
+    def __init__(
+        self,
+        params,
+        cfg: ModelConfig,
+        max_slots: int = 8,
+        max_seq_len: int | None = None,
+        buckets: list[int] | None = None,
+        mesh=None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_seq = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+        self.buckets = buckets or default_buckets(self.max_seq)
+        self.mesh = mesh
+        self.stats = BatcherStats()
+
+        fwd = partial(forward, cfg=cfg)
+
+        @jax.jit
+        def prefill1(params, tokens, k1, v1):
+            logits, k1, v1 = fwd(
+                params, tokens=tokens, k_cache=k1, v_cache=v1,
+                start_pos=jnp.zeros((1,), jnp.int32),
+            )
+            return logits, k1, v1
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert(K, V, k1, v1, slot):
+            zero = jnp.zeros((), jnp.int32)
+            K = jax.lax.dynamic_update_slice(K, k1, (zero, slot, zero, zero, zero))
+            V = jax.lax.dynamic_update_slice(V, v1, (zero, slot, zero, zero, zero))
+            return K, V
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def decode(params, tok, K, V, pos, seeds, steps, temp, topk, topp):
+            logits, K, V = fwd(params, tokens=tok[:, None], k_cache=K, v_cache=V, start_pos=pos)
+            nxt = sample_rows(logits[:, -1, :], seeds, steps, temp, topk, topp)
+            return nxt, K, V
+
+        self._prefill1 = prefill1
+        self._insert = insert
+        self._decode = decode
+
+        self._inbox: _queue.Queue[_Request | None] = _queue.Queue()
+        self._slots: list[_Request | None] = [None] * max_slots
+        self._thread: threading.Thread | None = None
+        self._started = False
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._thread = threading.Thread(target=self._run, name="batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if not self._started or self._stopping:
+            return
+        self._stopping = True
+        self._inbox.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    # -- client API ----------------------------------------------------------
+
+    async def submit(self, prompt_ids: list[int], sp: SamplingParams) -> AsyncIterator[int]:
+        """Yield generated token ids for one request."""
+        if not self._started:
+            self.start()
+        if not prompt_ids:
+            return
+        if len(prompt_ids) >= self.max_seq:
+            raise ValueError(f"prompt of {len(prompt_ids)} tokens >= max_seq {self.max_seq}")
+        req = _Request(
+            prompt_ids=list(prompt_ids),
+            sp=sp,
+            loop=asyncio.get_running_loop(),
+            out=asyncio.Queue(),
+        )
+        self._inbox.put(req)
+        while True:
+            kind, value = await req.out.get()
+            if kind == "tok":
+                yield value
+            elif kind == "end":
+                return
+            else:
+                raise value
+
+    # -- device loop (owner thread) ------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_seq
+
+    def _run(self) -> None:
+        cfg = self.cfg
+        B = self.max_slots
+        K, V = make_cache(cfg, B, self.max_seq)
+        if self.mesh is not None:
+            from ..parallel.sharding import shard_cache
+
+            K, V = shard_cache(K, V, self.mesh)
+        tok = jnp.zeros((B,), jnp.int32)
+        # per-slot sampling tensors, rebuilt only when membership changes
+        temp = jnp.zeros((B,), jnp.float32)
+        topk = jnp.zeros((B,), jnp.int32)
+        topp = jnp.ones((B,), jnp.float32)
+        pos = jnp.zeros((B,), jnp.int32)
+        dirty = False
+
+        host_tok = [0] * B
+        host_pos = [0] * B
+        host_seed = [0] * B
+
+        def active() -> list[int]:
+            return [i for i, r in enumerate(self._slots) if r is not None]
+
+        def admit_one(req: _Request) -> None:
+            nonlocal K, V, tok, dirty
+            slot = self._slots.index(None)
+            n = len(req.prompt_ids)
+            bucket = self._bucket(n)
+            k1, v1 = make_cache(cfg, 1, self.max_seq)
+            tokens = jnp.asarray([req.prompt_ids + [0] * (bucket - n)], jnp.int32)
+            logits, k1, v1 = self._prefill1(self.params, tokens, k1, v1)
+            K, V = self._insert(K, V, k1, v1, jnp.int32(slot))
+            sp = req.sp
+            seed = sp.seed if sp.seed is not None else random.getrandbits(31)
+            first = sample_rows(
+                logits[:, n - 1, :],
+                jnp.asarray([seed], jnp.int32),
+                jnp.zeros((1,), jnp.int32),
+                jnp.full((1,), sp.temperature, jnp.float32),
+                jnp.full((1,), sp.top_k, jnp.int32),
+                jnp.full((1,), sp.top_p, jnp.float32),
+            )
+            first_id = int(first[0])
+            req.slot = slot
+            req.pos = n
+            self._slots[slot] = req
+            self.stats.requests += 1
+            dirty = True
+            host_pos[slot] = n
+            host_tok[slot] = first_id
+            host_seed[slot] = seed
+            if not self._deliver(req, first_id):
+                self._slots[slot] = None  # stopped on the very first token
+
+        waitlist: list[_Request] = []
+        while True:
+            act = active()
+            self.stats.peak_active = max(self.stats.peak_active, len(act))
+            # intake: block when fully idle, otherwise just drain what's queued
+            block = not act and not waitlist
+            while True:
+                try:
+                    item = self._inbox.get(block=block)
+                except _queue.Empty:
+                    break
+                block = False
+                if item is None:
+                    self._drain_all("shutdown")
+                    return
+                waitlist.append(item)
+            # admit as many waiters as there are free slots
+            while waitlist and None in self._slots:
+                req = waitlist.pop(0)
+                try:
+                    admit_one(req)
+                except Exception as e:  # noqa: BLE001 — surface to the caller
+                    req.emit("err", e)
+            act = active()
+            if not act:
+                continue
+
+            if dirty:
+                temp = jnp.asarray(
+                    [r.sp.temperature if r else 0.0 for r in self._slots], jnp.float32
+                )
+                topk = jnp.asarray([r.sp.top_k if r else 0 for r in self._slots], jnp.int32)
+                topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in self._slots], jnp.float32)
+                dirty = False
+            tok = jnp.asarray(host_tok, jnp.int32)
+            pos = jnp.asarray(host_pos, jnp.int32)
+            seeds = jnp.asarray(host_seed, jnp.int32)
+            steps = jnp.asarray(
+                [r.generated if r else 0 for r in self._slots], jnp.int32
+            )
+            nxt, K, V = self._decode(self.params, tok, K, V, pos, seeds, steps, temp, topk, topp)
+            ids = [int(x) for x in nxt]  # one host transfer per step
+            self.stats.steps += 1
+            for i in act:
+                req = self._slots[i]
+                if req is None:
+                    continue
+                req.pos += 1
+                host_pos[i] = req.pos
+                host_tok[i] = ids[i]
+                if not self._deliver(req, ids[i]):
+                    self._slots[i] = None
+                    host_tok[i] = 0
+                    host_pos[i] = 0
+                    dirty = True
+
+    def _deliver(self, req: _Request, tok_id: int) -> bool:
+        """Push one token; returns False when the request just finished."""
+        if tok_id in req.sp.stop_ids:
+            req.emit("end", "stop")
+            return False
+        req.generated += 1
+        self.stats.tokens += 1
+        req.emit("tok", tok_id)
+        if req.generated >= req.sp.max_tokens or req.pos + 1 >= self.max_seq:
+            req.emit("end", "length")
+            return False
+        return True
+
+    def _drain_all(self, reason: str) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                req.emit("end", reason)
+                self._slots[i] = None
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except _queue.Empty:
+                return
+            if req is not None:
+                req.emit("end", reason)

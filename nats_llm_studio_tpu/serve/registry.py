@@ -15,7 +15,7 @@ from typing import Any, AsyncIterator
 
 import jax
 
-from ..engine.generator import Generator, SamplingParams
+from ..engine.generator import GenStats, SamplingParams
 from ..gguf.reader import GGUFReader
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
@@ -24,32 +24,32 @@ from ..parallel.sharding import shard_params, validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
 from ..utils.nuid import next_nuid
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
+from .batcher import ContinuousBatcher
 from .template import render_chat_template, stop_token_ids
 
 log = logging.getLogger(__name__)
 
 
 class JaxChatEngine(ChatEngine):
-    """One loaded model: tokenizer + jitted generator behind a single-owner
-    lock (the decode loop is the one shared-mutable structure — SURVEY.md §5
-    race-detection note)."""
+    """One loaded model: tokenizer + continuous batcher. Concurrent chats
+    join the shared fixed-width decode step; the batcher's dedicated owner
+    thread is the only mutator of device state (SURVEY.md §5)."""
 
     def __init__(
         self,
         model_id: str,
-        generator: Generator,
+        batcher: ContinuousBatcher,
         tokenizer: GGUFTokenizer,
         cfg: ModelConfig,
         meta: dict[str, Any],
         quantization: str = "",
     ):
         self.model_id = model_id
-        self.generator = generator
+        self.batcher = batcher
         self.tokenizer = tokenizer
         self.cfg = cfg
         self.meta = meta
         self.quantization = quantization
-        self._lock = asyncio.Lock()
         self._stop_ids = stop_token_ids(tokenizer)
 
     # -- internals -----------------------------------------------------------
@@ -114,51 +114,34 @@ class JaxChatEngine(ChatEngine):
     async def chat_stream(self, payload: dict) -> AsyncIterator[dict]:
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
-        loop = asyncio.get_running_loop()
-        queue: asyncio.Queue = asyncio.Queue()
-        _DONE = object()
-
-        def run() -> None:
-            try:
-                stats = None
-                for tok, stats in self.generator.generate(prompt_ids, sp):
-                    loop.call_soon_threadsafe(queue.put_nowait, ("tok", tok, stats))
-                loop.call_soon_threadsafe(queue.put_nowait, ("end", None, stats))
-            except Exception as e:  # noqa: BLE001 — surfaced as EngineError below
-                loop.call_soon_threadsafe(queue.put_nowait, ("err", e, None))
-
-        async with self._lock:  # single owner of the decode loop
-            task = loop.run_in_executor(None, run)
-            toks: list[int] = []
-            emitted = 0
-            stats = None
-            try:
-                while True:
-                    kind, item, st = await queue.get()
-                    if kind == "err":
-                        raise EngineError(str(item)) from item
-                    if kind == "end":
-                        stats = st
-                        break
-                    toks.append(item)
-                    stats = st
-                    # decode incrementally; emit only completed UTF-8 text
-                    text = self.tokenizer.decode(toks)
-                    if len(text) > emitted and not text.endswith("�"):
-                        yield {
-                            "object": "chat.completion.chunk",
-                            "model": self.model_id,
-                            "choices": [
-                                {
-                                    "index": 0,
-                                    "delta": {"role": "assistant", "content": text[emitted:]},
-                                    "finish_reason": None,
-                                }
-                            ],
-                        }
-                        emitted = len(text)
-            finally:
-                await task
+        stats = GenStats(prompt_tokens=len(prompt_ids))
+        t0 = time.perf_counter()
+        toks: list[int] = []
+        emitted = 0
+        try:
+            async for tok_id in self.batcher.submit(prompt_ids, sp):
+                if not toks:
+                    stats.ttft_s = time.perf_counter() - t0
+                toks.append(tok_id)
+                stats.completion_tokens += 1
+                # decode incrementally; emit only completed UTF-8 text
+                text = self.tokenizer.decode(toks)
+                if len(text) > emitted and not text.endswith("�"):
+                    yield {
+                        "object": "chat.completion.chunk",
+                        "model": self.model_id,
+                        "choices": [
+                            {
+                                "index": 0,
+                                "delta": {"role": "assistant", "content": text[emitted:]},
+                                "finish_reason": None,
+                            }
+                        ],
+                    }
+                    emitted = len(text)
+        except ValueError as e:  # e.g. prompt longer than max_seq
+            raise EngineError(str(e)) from e
+        stats.total_s = time.perf_counter() - t0
         text = self.tokenizer.decode(toks)
         if len(text) > emitted:
             # flush text held back by the incomplete-UTF-8 guard so the chunk
@@ -187,11 +170,12 @@ class JaxChatEngine(ChatEngine):
             "quantization": self.quantization,
             "state": "loaded",
             "max_context_length": self.cfg.max_seq_len,
-            "loaded_context_length": self.generator.max_seq,
+            "loaded_context_length": self.batcher.max_seq,
+            "batch_slots": self.batcher.max_slots,
         }
 
     async def unload(self) -> None:
-        self.generator = None  # type: ignore[assignment]
+        await asyncio.to_thread(self.batcher.stop)
 
 
 class LocalRegistry(Registry):
@@ -203,13 +187,13 @@ class LocalRegistry(Registry):
         mesh=None,
         dtype: str | None = None,
         max_seq_len: int | None = None,
-        warmup: bool = False,
+        max_batch_slots: int = 8,
     ):
         self.store = store
         self.mesh = mesh
         self.dtype = dtype or ("float32" if jax.default_backend() == "cpu" else "bfloat16")
         self.max_seq_len = max_seq_len
-        self.warmup = warmup
+        self.max_batch_slots = max_batch_slots
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
         self._requests = 0
@@ -255,7 +239,7 @@ class LocalRegistry(Registry):
 
     async def sync_from_bucket(self, name: str, model_id: str | None = None) -> str:
         try:
-            path, _ = await self.store.pull(name)
+            path, _ = await self.store.pull(name, model_id=model_id)
         except StoreError as e:
             raise EngineError(str(e)) from None
         return str(path)
@@ -279,7 +263,10 @@ class LocalRegistry(Registry):
     def _load(self, model_id: str, path: str) -> JaxChatEngine:
         t0 = time.perf_counter()
         reader = GGUFReader(path)
-        cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(dtype=self.dtype)
+        cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(
+            dtype=self.dtype,
+            use_flash_attention=jax.default_backend() == "tpu",  # prefill TTFT
+        )
         tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
         params = load_params_from_gguf(reader, cfg)
         quant = {t.ggml_type.name for t in reader.tensors.values()}
@@ -288,18 +275,29 @@ class LocalRegistry(Registry):
             params = shard_params(params, self.mesh)
         meta = dict(reader.metadata)
         reader.close()
-        gen = Generator(params, cfg, max_seq_len=self.max_seq_len)
-        if self.warmup:
-            gen.warmup()
+        batcher = ContinuousBatcher(
+            params, cfg, max_slots=self.max_batch_slots, max_seq_len=self.max_seq_len,
+            mesh=self.mesh,
+        )
+        batcher.start()
         log.info("loaded %s in %.1fs (%s, %s)", model_id, time.perf_counter() - t0,
                  cfg.arch, self.dtype)
         return JaxChatEngine(
-            model_id, gen, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
+            model_id, batcher, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
         )
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "models_cached": len(self.store.cached()),
             "models_loaded": len(self._engines),
+            "engine_requests": self._requests,
             "backend": jax.default_backend(),
         }
+        batchers = {
+            mid: eng.batcher.stats.snapshot()
+            for mid, eng in self._engines.items()
+            if eng.batcher is not None
+        }
+        if batchers:
+            out["batcher"] = batchers
+        return out

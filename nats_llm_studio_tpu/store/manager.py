@@ -11,6 +11,7 @@ fixed here: ids are always ``publisher/model`` and never re-prefixed.
 
 from __future__ import annotations
 
+import asyncio
 import shutil
 from dataclasses import dataclass
 from pathlib import Path
@@ -137,15 +138,18 @@ class ModelStore:
         pub, name = split_model_id(model_id)
         obj_name = f"{pub}/{name}/{gguf_path.name}"
         await store.ensure_bucket(self.bucket)
-        await store.put(self.bucket, obj_name, gguf_path.read_bytes())
+        data = await asyncio.to_thread(gguf_path.read_bytes)  # keep the loop serving
+        await store.put(self.bucket, obj_name, data)
         return obj_name
 
-    async def pull(self, identifier: str) -> tuple[Path, str]:
+    async def pull(self, identifier: str, model_id: str | None = None) -> tuple[Path, str]:
         """Fetch a model from the bucket into the local cache (the `lms get`
         replacement, nats_llm_studio.go:46-59; conceptual sync flow
         README.md:286-318). ``identifier`` is an object name
-        ``publisher/model/file.gguf`` or a model id ``publisher/model``.
-        Returns (local_path, transcript)."""
+        ``publisher/model/file.gguf`` or a model id ``publisher/model``;
+        ``model_id`` overrides the cache location (README.md:306 lets the
+        sync flow choose the local model dir). Returns (local_path,
+        transcript)."""
         store = self._require_store()
         lines = [f"pulling {identifier!r} from bucket {self.bucket!r}"]
         obj_name = identifier.strip().strip("/")
@@ -166,10 +170,13 @@ class ModelStore:
             raise StoreError(
                 f"object name {obj_name!r} must be <publisher>/<model>/<file>.gguf"
             )
-        pub, name, fname = parts[0], "/".join(parts[1:-1]), parts[-1]
-        dest_dir = self.models_dir / pub / name
+        fname = parts[-1]
+        if model_id:
+            dest_dir = self.model_dir(model_id)
+        else:
+            dest_dir = self.models_dir / parts[0] / "/".join(parts[1:-1])
         dest_dir.mkdir(parents=True, exist_ok=True)
         dest = dest_dir / fname
-        dest.write_bytes(data)
+        await asyncio.to_thread(dest.write_bytes, data)  # keep the loop serving
         lines.append(f"wrote {len(data)} bytes to {dest}")
         return dest, "\n".join(lines)

@@ -146,6 +146,14 @@ class ObjectStore:
         self, bucket: str, name: str, data: bytes, chunk_size: int = DEFAULT_CHUNK,
         description: str = "",
     ) -> ObjectInfo:
+        # overwrite: remember the previous revision's chunk subject so its
+        # chunks can be purged after the metadata rollup (otherwise every
+        # re-publish leaks the full old blob in the stream)
+        old_nuid: str | None = None
+        try:
+            old_nuid = (await self.info(bucket, name)).nuid
+        except ObjectStoreError:
+            pass
         nuid = next_nuid()
         chunk_subject = f"$O.{bucket}.C.{nuid}"
         n_chunks = 0
@@ -181,6 +189,11 @@ class ObjectStore:
             headers={"Nats-Rollup": "sub"},
         )
         await self.nc.flush()
+        if old_nuid and old_nuid != nuid:
+            await self._api(
+                f"STREAM.PURGE.{self._stream(bucket)}",
+                {"filter": f"$O.{bucket}.C.{old_nuid}"},
+            )
         return info
 
     async def info(self, bucket: str, name: str) -> ObjectInfo:

@@ -1,0 +1,155 @@
+"""Continuous batcher tests: batched greedy decode must reproduce
+single-stream generation exactly; slots admit/release mid-flight;
+oversubscription queues (SURVEY.md §7 hard part #5)."""
+
+import asyncio
+
+import jax
+import pytest
+
+from nats_llm_studio_tpu.engine.generator import Generator, SamplingParams
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+from conftest import async_test
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def reference_greedy(cfg, params, prompt, n):
+    gen = Generator(params, cfg, max_seq_len=64, buckets=[8, 16, 32, 64])
+    sp = SamplingParams(temperature=0.0, max_tokens=n)
+    return [t for t, _ in gen.generate(prompt, sp)]
+
+
+@async_test
+async def test_concurrent_greedy_matches_single_stream(model):
+    cfg, params = model
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [5], [10, 20, 30, 40, 50]]
+    want = [reference_greedy(cfg, params, p, 6) for p in prompts]
+
+    b = ContinuousBatcher(params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64])
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=6)
+            return [t async for t in b.submit(p, sp)]
+
+        got = await asyncio.gather(*[run(p) for p in prompts])
+        assert list(got) == want
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_join_mid_generation(model):
+    cfg, params = model
+    a, c = [1, 2, 3], [4, 5, 6, 7]
+    want_a = reference_greedy(cfg, params, a, 8)
+    want_c = reference_greedy(cfg, params, c, 8)
+
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    try:
+        got_a: list[int] = []
+        got_c: list[int] = []
+
+        async def run_a():
+            sp = SamplingParams(temperature=0.0, max_tokens=8)
+            async for t in b.submit(a, sp):
+                got_a.append(t)
+
+        async def run_c_later():
+            while len(got_a) < 2:  # join after A has streamed a couple tokens
+                await asyncio.sleep(0.01)
+            sp = SamplingParams(temperature=0.0, max_tokens=8)
+            async for t in b.submit(c, sp):
+                got_c.append(t)
+
+        await asyncio.gather(run_a(), run_c_later())
+        assert got_a == want_a
+        assert got_c == want_c
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_oversubscription_queues(model):
+    cfg, params = model
+    prompts = [[i + 1, i + 2] for i in range(6)]
+    want = [reference_greedy(cfg, params, p, 4) for p in prompts]
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=4)
+            return [t async for t in b.submit(p, sp)]
+
+        got = await asyncio.gather(*[run(p) for p in prompts])
+        assert list(got) == want
+        assert b.stats.requests == 6
+        assert b.stats.peak_active <= 2
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_stop_ids_and_max_tokens(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    try:
+        first = reference_greedy(cfg, params, [3, 4], 1)[0]
+        sp = SamplingParams(temperature=0.0, max_tokens=8, stop_ids=frozenset({first}))
+        out = [t async for t in b.submit([3, 4], sp)]
+        assert out == []  # first token is the stop token
+        sp2 = SamplingParams(temperature=0.0, max_tokens=3)
+        out2 = [t async for t in b.submit([3, 4], sp2)]
+        assert len(out2) == 3
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_prompt_too_long_raises(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=16, buckets=[8, 16])
+    try:
+        with pytest.raises(ValueError):
+            async for _ in b.submit(list(range(1, 20)), SamplingParams()):
+                pass
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_seeded_sampling_reproducible_across_batch_composition(model):
+    """A seeded request must reproduce its completion token-for-token no
+    matter what else shares the batch (per-row fold_in PRNG)."""
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64])
+    try:
+        sp = SamplingParams(temperature=1.5, max_tokens=6, seed=1234)
+
+        async def seeded():
+            return [t async for t in b.submit([2, 3, 4], sp)]
+
+        alone = await seeded()
+        # same request again, now alongside three noisy neighbours
+        noise = SamplingParams(temperature=2.0, max_tokens=12)
+        crowd = await asyncio.gather(
+            seeded(),
+            *[
+                _collect(b, [9 + i, 8, 7], noise)
+                for i in range(3)
+            ],
+        )
+        assert crowd[0] == alone
+    finally:
+        b.stop()
+
+
+async def _collect(b, prompt, sp):
+    return [t async for t in b.submit(prompt, sp)]

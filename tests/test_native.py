@@ -1,0 +1,38 @@
+"""Native (C++/ctypes) dequant parity with the NumPy reference."""
+
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu import native
+from nats_llm_studio_tpu.gguf import GGMLType, quantize
+from nats_llm_studio_tpu.gguf.quants import _DEQUANT, _blocks
+
+RNG = np.random.default_rng(3)
+
+
+def test_toolchain_builds():
+    # g++ is part of the target environment; the native path must come up
+    assert native.available()
+
+
+@pytest.mark.parametrize(
+    "ttype", [GGMLType.Q8_0, GGMLType.Q4_0, GGMLType.Q4_K, GGMLType.Q5_K, GGMLType.Q6_K]
+)
+def test_native_matches_numpy(ttype):
+    x = (RNG.standard_normal(8192) * 2.5).astype(np.float32)
+    blob = quantize(x, ttype)
+    want = _DEQUANT[ttype](_blocks(blob, ttype, x.size)).reshape(-1)
+    got = native.dequantize_native(blob, int(ttype), x.size)
+    assert got is not None
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_native_handles_positive_offset_kquants():
+    x = RNG.uniform(3.0, 4.0, 4096).astype(np.float32)
+    blob = quantize(x, GGMLType.Q4_K)
+    got = native.dequantize_native(blob, int(GGMLType.Q4_K), x.size)
+    np.testing.assert_allclose(got, x, rtol=0.05, atol=0.05)
+
+
+def test_unsupported_type_returns_none():
+    assert native.dequantize_native(b"\x00" * 64, 999, 32) is None

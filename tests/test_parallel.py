@@ -98,7 +98,7 @@ def test_sharded_decode_consistency():
     mesh = build_mesh("tp=8")
     sp = shard_params(params, mesh)
     k, v = shard_cache(*make_cache(cfg, 1, 16), mesh)
-    logits, k, v = forward(params, cfg, full[:, :3], k, v, jnp.zeros((1,), jnp.int32))
+    logits, k, v = forward(sp, cfg, full[:, :3], k, v, jnp.zeros((1,), jnp.int32))
     for t in range(3, 5):
         logits, k, v = forward(sp, cfg, full[:, t : t + 1], k, v, jnp.full((1,), t, jnp.int32))
         np.testing.assert_allclose(np.asarray(logits[0, 0]), ref[0, t], rtol=2e-3, atol=2e-3)

@@ -1,0 +1,59 @@
+"""Ring attention over the sp axis vs dense causal attention (8-device
+virtual CPU mesh — SURVEY.md §4.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.ops.layers import gqa_attention
+from nats_llm_studio_tpu.parallel import build_mesh
+from nats_llm_studio_tpu.parallel.ring_attention import ring_attention, ring_attention_sharded
+
+RNG = jax.random.PRNGKey(7)
+
+
+def _dense_causal(q, k, v, scale):
+    t = q.shape[1]
+    pos = jnp.arange(t)
+    mask = jnp.broadcast_to(pos[None, :] <= pos[:, None], (q.shape[0], t, t))
+    return gqa_attention(q, k, v, mask, scale)
+
+
+@pytest.mark.parametrize(
+    "spec,b,t,hq,hkv,d",
+    [
+        ("sp=8", 1, 64, 4, 4, 16),   # MHA, 8-way ring
+        ("sp=4,dp=2", 2, 32, 8, 2, 8),  # GQA + dp on the same mesh
+        ("sp=2,tp=4", 1, 16, 4, 4, 8),  # ring alongside a tp axis
+    ],
+)
+def test_ring_matches_dense(spec, b, t, hq, hkv, d):
+    kq, kk, kv = jax.random.split(RNG, 3)
+    q = jax.random.normal(kq, (b, t, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, hkv, d), jnp.float32)
+    scale = d**-0.5
+    want = _dense_causal(q, k, v, scale)
+    mesh = build_mesh(spec)
+    got = ring_attention(q, k, v, scale, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit():
+    mesh = build_mesh("sp=8")
+    q = jax.random.normal(RNG, (1, 64, 2, 8), jnp.float32)
+    scale = 8**-0.5
+    fn = jax.jit(lambda q: ring_attention(q, q, q, scale, mesh))
+    got = fn(q)
+    want = _dense_causal(q, q, q, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_helper_falls_back_without_sp():
+    mesh = build_mesh("tp=8")
+    q = jax.random.normal(RNG, (1, 16, 2, 8), jnp.float32)
+    scale = 8**-0.5
+    got = ring_attention_sharded(q, q, q, scale, mesh)
+    want = _dense_causal(q, q, q, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)

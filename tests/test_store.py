@@ -161,3 +161,34 @@ def test_pull_requires_objstore(tmp_path):
     ms = ModelStore(tmp_path / "m")
     with pytest.raises(StoreError):
         asyncio.run(ms.pull("a/b"))
+
+
+@async_test
+async def test_overwrite_purges_old_chunks():
+    """Re-publishing an object must not leak the previous revision's chunks
+    in the stream (they are purged after the metadata rollup)."""
+    async with JsHarness() as h:
+        await h.os.ensure_bucket("b")
+        big = b"x" * (300 * 1024)  # 3 chunks
+        await h.os.put("b", "obj", big)
+        st = h.module.streams["OBJ_b"]
+        bytes_v1 = st.bytes_total()
+        await h.os.put("b", "obj", big)
+        assert st.bytes_total() <= bytes_v1 + 1024  # old chunks reclaimed
+        assert await h.os.get("b", "obj") == big
+
+
+@async_test
+async def test_pull_with_model_id_override(tmp_path):
+    """sync_model_from_bucket's model_id chooses the local cache dir."""
+    async with JsHarness() as h:
+        pub = ModelStore(tmp_path / "pub", objstore=h.os)
+        src = tmp_path / "m.gguf"
+        src.write_bytes(b"WEIGHTS")
+        pub.import_file(src, "acme/original")
+        await pub.publish_model("acme/original")
+        ms = ModelStore(tmp_path / "worker", objstore=h.os)
+        path, _ = await ms.pull("acme/original/m.gguf", model_id="other/renamed")
+        assert ms.lookup("other/renamed") is not None
+        assert ms.lookup("acme/original") is None
+        assert path.read_bytes() == b"WEIGHTS"
