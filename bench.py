@@ -57,9 +57,14 @@ def main() -> None:
         logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start)
         return sample(logits[:, -1, :], jax.random.PRNGKey(1), temperature=0.0), k, v
 
+    # serving picks an attention-window bucket when it is well under the full
+    # cache length (see batcher); at these bench shapes the full cache wins
+    window = None
+
     @partial(jax.jit, donate_argnums=(2, 3))
     def decode(params, tok, k, v, pos):
-        logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v, start_pos=pos)
+        logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v, start_pos=pos,
+                           attn_window=window)
         return sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0), k, v
 
     @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4,))
@@ -71,7 +76,7 @@ def main() -> None:
         def body(carry, i):
             tok, k, v = carry
             logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v,
-                               start_pos=pos0 + i)
+                               start_pos=pos0 + i, attn_window=window)
             nxt = sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0)
             return (nxt, k, v), nxt
 

@@ -41,6 +41,7 @@ def _attention_block(
     cos: jax.Array,
     sin: jax.Array,
     mask: jax.Array,
+    attn_window: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -60,9 +61,15 @@ def _attention_block(
         # attention over the fresh block equals attention over the cache
         out = flash_attention_auto(q, k, v, cfg.attn_scale)
     else:
-        out = gqa_attention(
-            q, k_cache.astype(q.dtype), v_cache.astype(q.dtype), mask, cfg.attn_scale
-        )
+        k_att, v_att = k_cache, v_cache
+        if attn_window is not None and attn_window < k_cache.shape[1]:
+            # decode HBM traffic is dominated by reading the cache; a static
+            # window bucket >= the longest live sequence reads only the
+            # active prefix instead of all S_max slots
+            k_att = jax.lax.slice_in_dim(k_cache, 0, attn_window, axis=1)
+            v_att = jax.lax.slice_in_dim(v_cache, 0, attn_window, axis=1)
+            mask = jax.lax.slice_in_dim(mask, 0, attn_window, axis=2)
+        out = gqa_attention(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask, cfg.attn_scale)
     return out.reshape(b, t, hq * d) @ p["wo"], k_cache, v_cache
 
 
@@ -90,13 +97,16 @@ def forward(
     k_cache: jax.Array,  # [L, B, S, Hkv, D]
     v_cache: jax.Array,
     start_pos: jax.Array,  # int32 [B] — write offset per row (0 for prefill)
+    attn_window: int | None = None,  # static: attend to cache[:window] only
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache).
 
     Handles prefill (T > 1, start_pos = 0) and batched decode (T = 1,
     start_pos = current length per row) with one trace. Right-padded prompts
     are safe: pad keys sit at positions only pad queries can see, and decode
-    overwrites them in order.
+    overwrites them in order. ``attn_window`` (a compile-time bucket >= every
+    live sequence length) bounds decode attention reads to the active cache
+    prefix.
     """
     b, t = tokens.shape
     s_max = k_cache.shape[2]
@@ -110,7 +120,8 @@ def forward(
     def block(x: jax.Array, layer: tuple[Params, jax.Array, jax.Array]):
         p, kc, vc = layer
         attn_out, kc, vc = _attention_block(
-            rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, kc, vc, start_pos, cos, sin, mask
+            rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, kc, vc, start_pos, cos, sin,
+            mask, attn_window,
         )
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)

@@ -1,0 +1,98 @@
+"""Streaming sharded weight loading.
+
+SURVEY.md §7 hard part #2: a 70B GGUF is ~40 GB on disk and ~140 GB as bf16 —
+materializing the full pytree on host before sharding (models/llama.py's
+``load_params_from_gguf``) cannot work there. This loader walks the tensor
+index one entry at a time: mmap read -> dequant (native C++ path) -> cast ->
+``jax.device_put`` with the tensor's NamedSharding -> host buffer released,
+so peak host memory is one tensor, not one model. Stacked [L]-leading leaves
+are assembled on device layer-by-layer via per-layer placement and
+``jax.lax`` concatenation-free stacking (device_put per layer slice into the
+stacked sharding).
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.llama import _rope_deinterleave
+from .sharding import param_sharding_rules
+
+log = logging.getLogger(__name__)
+
+
+def _layer_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    """Sharding for one [L]-slice of a stacked leaf (drop the L axis rule)."""
+    return NamedSharding(mesh, P(*spec[1:]))
+
+
+def _place(arr: np.ndarray, mesh: Mesh, spec: P, dtype) -> jax.Array:
+    return jax.device_put(jnp.asarray(arr, dtype), NamedSharding(mesh, spec))
+
+
+def load_params_sharded(
+    reader, cfg: ModelConfig, mesh: Mesh, dtype: str | None = None
+) -> dict[str, Any]:
+    """Build the stacked-params pytree directly on the mesh, one tensor at a
+    time. Same tensor-name contract as models.llama.load_params_from_gguf."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    rules = param_sharding_rules(mesh)
+
+    def t(name: str) -> np.ndarray:
+        return reader.tensor(name).to_numpy()
+
+    def mat(name: str) -> np.ndarray:
+        return np.ascontiguousarray(t(name).T)
+
+    params: dict[str, Any] = {
+        "embed": _place(t("token_embd.weight"), mesh, rules["embed"], dt),
+        "out_norm": _place(t("output_norm.weight"), mesh, rules["out_norm"], dt),
+    }
+    if "output.weight" in reader.tensors:
+        params["lm_head"] = _place(mat("output.weight"), mesh, rules["lm_head"], dt)
+
+    # stacked per-layer leaves: place each layer slice with the slice
+    # sharding, then stack on-device (jnp.stack of committed sharded arrays
+    # stays on device; the host copy of each slice dies right after placement)
+    per_layer: dict[str, list[jax.Array]] = {}
+
+    def push(key: str, arr: np.ndarray) -> None:
+        spec = rules[f"blocks.{key}"]
+        sh = _layer_sharding(mesh, spec)
+        per_layer.setdefault(key, []).append(jax.device_put(jnp.asarray(arr, dt), sh))
+
+    for i in range(cfg.n_layers):
+        pre = f"blk.{i}"
+        push("attn_norm", t(f"{pre}.attn_norm.weight"))
+        push("ffn_norm", t(f"{pre}.ffn_norm.weight"))
+        push("wq", _rope_deinterleave(mat(f"{pre}.attn_q.weight"), cfg.n_heads, cfg.head_dim))
+        push("wk", _rope_deinterleave(mat(f"{pre}.attn_k.weight"), cfg.n_kv_heads, cfg.head_dim))
+        push("wv", mat(f"{pre}.attn_v.weight"))
+        push("wo", mat(f"{pre}.attn_output.weight"))
+        if cfg.is_moe:
+            push("router", mat(f"{pre}.ffn_gate_inp.weight"))
+            push("w_gate_e", t(f"{pre}.ffn_gate_exps.weight").transpose(0, 2, 1))
+            push("w_up_e", t(f"{pre}.ffn_up_exps.weight").transpose(0, 2, 1))
+            push("w_down_e", t(f"{pre}.ffn_down_exps.weight").transpose(0, 2, 1))
+        else:
+            push("w_gate", mat(f"{pre}.ffn_gate.weight"))
+            push("w_up", mat(f"{pre}.ffn_up.weight"))
+            push("w_down", mat(f"{pre}.ffn_down.weight"))
+        if i % 8 == 7:
+            gc.collect()  # drop dequant temporaries promptly on big models
+
+    blocks: dict[str, jax.Array] = {}
+    for key, slices in per_layer.items():
+        spec = rules[f"blocks.{key}"]
+        stacked = jnp.stack(slices)
+        blocks[key] = jax.device_put(stacked, NamedSharding(mesh, spec))
+    params["blocks"] = blocks
+    return params

@@ -104,9 +104,12 @@ class ContinuousBatcher:
             V = jax.lax.dynamic_update_slice(V, v1, (zero, slot, zero, zero, zero))
             return K, V
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def decode(params, tok, K, V, pos, seeds, steps, temp, topk, topp):
-            logits, K, V = fwd(params, tokens=tok[:, None], k_cache=K, v_cache=V, start_pos=pos)
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(10,))
+        def decode(params, tok, K, V, pos, seeds, steps, temp, topk, topp, window):
+            logits, K, V = fwd(
+                params, tokens=tok[:, None], k_cache=K, v_cache=V, start_pos=pos,
+                attn_window=window,
+            )
             nxt = sample_rows(logits[:, -1, :], seeds, steps, temp, topk, topp)
             return nxt, K, V
 
@@ -265,7 +268,15 @@ class ContinuousBatcher:
             steps = jnp.asarray(
                 [r.generated if r else 0 for r in self._slots], jnp.int32
             )
-            nxt, K, V = self._decode(self.params, tok, K, V, pos, seeds, steps, temp, topk, topp)
+            # attention reads only the bucket covering the longest live row —
+            # but XLA materializes the sliced cache, so the slice only pays
+            # when the window is well under the full cache length
+            window = self._bucket(max(host_pos[i] for i in act) + 1)
+            if window * 3 > self.max_seq:
+                window = None
+            nxt, K, V = self._decode(
+                self.params, tok, K, V, pos, seeds, steps, temp, topk, topp, window
+            )
             ids = [int(x) for x in nxt]  # one host transfer per step
             self.stats.steps += 1
             for i in act:

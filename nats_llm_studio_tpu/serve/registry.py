@@ -20,7 +20,7 @@ from ..gguf.reader import GGUFReader
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
-from ..parallel.sharding import shard_params, validate_mesh_for_config
+from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
 from ..utils.nuid import next_nuid
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
@@ -268,11 +268,16 @@ class LocalRegistry(Registry):
             use_flash_attention=jax.default_backend() == "tpu",  # prefill TTFT
         )
         tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
-        params = load_params_from_gguf(reader, cfg)
         quant = {t.ggml_type.name for t in reader.tensors.values()}
         if self.mesh is not None:
+            # stream tensors straight onto the mesh: peak host memory is one
+            # tensor, so 70B-class files load on small-RAM workers
+            from ..parallel.loader import load_params_sharded
+
             validate_mesh_for_config(self.mesh, cfg)
-            params = shard_params(params, self.mesh)
+            params = load_params_sharded(reader, cfg, self.mesh)
+        else:
+            params = load_params_from_gguf(reader, cfg)
         meta = dict(reader.metadata)
         reader.close()
         batcher = ContinuousBatcher(

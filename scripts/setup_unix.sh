@@ -1,0 +1,79 @@
+#!/usr/bin/env bash
+# Host bootstrap for nats-llm-studio-tpu (the analog of the reference's
+# scripts/setup_unix.sh, which installed LM Studio + nats-server; here both
+# roles are served in-tree, so setup is: venv check, .env, dirs, smoke test).
+set -euo pipefail
+
+NATS_PORT="${NATS_PORT:-4222}"
+MODELS_DIR="${LMSTUDIO_MODELS_DIR:-$HOME/.lmstudio/models}"
+STORE_DIR="${NATS_STORE_DIR:-$PWD/nats_data}"
+
+echo "==> nats-llm-studio-tpu setup"
+
+command -v python >/dev/null || { echo "python not found"; exit 1; }
+python - <<'EOF'
+import importlib, sys
+missing = [m for m in ("jax", "numpy") if importlib.util.find_spec(m) is None]
+if missing:
+    sys.exit(f"missing python deps: {missing} (pip install nats-llm-studio-tpu)")
+import jax
+print(f"    jax {jax.__version__}, default backend: {jax.default_backend()}")
+EOF
+
+mkdir -p "$MODELS_DIR" "$STORE_DIR"
+echo "    models dir: $MODELS_DIR"
+echo "    broker store: $STORE_DIR"
+
+cat > .env <<EOF
+NATS_URL=nats://127.0.0.1:${NATS_PORT}
+LMSTUDIO_MODELS_DIR=${MODELS_DIR}
+NATS_QUEUE_GROUP=lmstudio-workers
+MODEL_BUCKET=llm-models
+MAX_BATCH_SLOTS=8
+MAX_SEQ_LEN=4096
+# TPU_MESH=tp=8            # uncomment to pin a mesh layout
+# JAX_COORDINATOR_ADDRESS= # host:port for multi-host meshes
+EOF
+echo "    wrote .env"
+
+echo "==> smoke test (embedded broker + worker handshake)"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)" python - <<'EOF'
+import asyncio
+import importlib.util
+import os
+import sys
+
+if importlib.util.find_spec("nats_llm_studio_tpu") is None:
+    sys.path.insert(0, os.environ["REPO_DIR"])  # running from a source checkout
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.registry import LocalRegistry
+from nats_llm_studio_tpu.store import JetStreamStoreModule, ModelStore
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+
+
+async def main():
+    broker = await EmbeddedBroker().start()
+    JetStreamStoreModule(broker).install()
+    cfg = WorkerConfig(nats_url=broker.url)
+    worker = Worker(cfg, LocalRegistry(ModelStore(cfg.models_dir)))
+    await worker.start()
+    nc = await connect(broker.url)
+    msg = await nc.request("lmstudio.health", b"{}", timeout=5)
+    assert b'"ok": true' in msg.payload or b'"ok":true' in msg.payload, msg.payload
+    await nc.close()
+    await worker.drain()
+    await broker.stop()
+    print("    health check OK")
+
+
+asyncio.run(main())
+EOF
+
+cat <<'EOF'
+==> done. Next:
+    python -m nats_llm_studio_tpu serve --embedded-broker          # start serving
+    python -m nats_llm_studio_tpu publish <model.gguf> <pub>/<name>
+    python -m nats_llm_studio_tpu chat <pub>/<name> "hello" --stream
+EOF

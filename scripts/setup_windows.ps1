@@ -1,0 +1,31 @@
+# Host bootstrap for nats-llm-studio-tpu on Windows (analog of the
+# reference's scripts/setup_windows.ps1 — no winget/choco installs needed:
+# broker and engine are in-tree).
+$ErrorActionPreference = "Stop"
+
+$NatsPort = if ($env:NATS_PORT) { $env:NATS_PORT } else { "4222" }
+$ModelsDir = if ($env:LMSTUDIO_MODELS_DIR) { $env:LMSTUDIO_MODELS_DIR } else { "$HOME\.lmstudio\models" }
+$StoreDir = if ($env:NATS_STORE_DIR) { $env:NATS_STORE_DIR } else { "$PWD\nats_data" }
+
+Write-Host "==> nats-llm-studio-tpu setup"
+
+python -c "import jax, numpy; print(f'    jax {jax.__version__}, backend: {jax.default_backend()}')"
+if ($LASTEXITCODE -ne 0) { throw "python/jax not available (pip install nats-llm-studio-tpu)" }
+
+New-Item -ItemType Directory -Force -Path $ModelsDir | Out-Null
+New-Item -ItemType Directory -Force -Path $StoreDir | Out-Null
+
+@"
+NATS_URL=nats://127.0.0.1:$NatsPort
+LMSTUDIO_MODELS_DIR=$ModelsDir
+NATS_QUEUE_GROUP=lmstudio-workers
+MODEL_BUCKET=llm-models
+MAX_BATCH_SLOTS=8
+MAX_SEQ_LEN=4096
+"@ | Set-Content -Path ".env"
+Write-Host "    wrote .env"
+
+Write-Host "==> done. Next:"
+Write-Host "    python -m nats_llm_studio_tpu serve --embedded-broker"
+Write-Host "    python -m nats_llm_studio_tpu publish <model.gguf> <pub>/<name>"
+Write-Host "    python -m nats_llm_studio_tpu chat <pub>/<name> ""hello"" --stream"

@@ -102,3 +102,50 @@ def test_sharded_decode_consistency():
     for t in range(3, 5):
         logits, k, v = forward(sp, cfg, full[:, t : t + 1], k, v, jnp.full((1,), t, jnp.int32))
         np.testing.assert_allclose(np.asarray(logits[0, 0]), ref[0, t], rtol=2e-3, atol=2e-3)
+
+
+def test_streaming_sharded_loader_matches(tmp_path):
+    """load_params_sharded (per-tensor streaming onto the mesh) must produce
+    the same numbers as full-host load + shard_params."""
+    from nats_llm_studio_tpu.gguf import GGUFReader
+    from nats_llm_studio_tpu.models.export import export_params_to_gguf
+    from nats_llm_studio_tpu.models.llama import load_params_from_gguf
+    from nats_llm_studio_tpu.parallel.loader import load_params_sharded
+
+    cfg = ModelConfig.tiny(n_heads=8, n_kv_heads=8, head_dim=8, d_model=64, d_ff=128, n_layers=3)
+    params = init_params(cfg, jax.random.PRNGKey(9))
+    path = tmp_path / "m.gguf"
+    export_params_to_gguf(path, params, cfg)
+    mesh = build_mesh("tp=8")
+    with GGUFReader(path) as r:
+        host = load_params_from_gguf(r, cfg)
+        streamed = load_params_sharded(r, cfg, mesh)
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    k, v = make_cache(cfg, 1, 16)
+    ref, _, _ = forward(host, cfg, tokens, k, v, jnp.zeros((1,), jnp.int32))
+    k, v = shard_cache(*make_cache(cfg, 1, 16), mesh)
+    got, _, _ = forward(streamed, cfg, tokens, k, v, jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_streaming_sharded_loader_moe(tmp_path):
+    from nats_llm_studio_tpu.gguf import GGUFReader
+    from nats_llm_studio_tpu.models.export import export_params_to_gguf
+    from nats_llm_studio_tpu.parallel.loader import load_params_sharded
+
+    cfg = ModelConfig.tiny(
+        n_heads=4, n_kv_heads=4, head_dim=8, d_model=32, d_ff=64,
+        n_experts=4, n_experts_used=2, n_layers=2,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(10))
+    path = tmp_path / "moe.gguf"
+    export_params_to_gguf(path, params, cfg)
+    mesh = build_mesh("dp=2,ep=4")
+    with GGUFReader(path) as r:
+        streamed = load_params_sharded(r, cfg, mesh)
+    tokens = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    k, v = make_cache(cfg, 2, 8)
+    ref, _, _ = forward(params, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32))
+    k, v = shard_cache(*make_cache(cfg, 2, 8), mesh)
+    got, _, _ = forward(streamed, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)

@@ -200,3 +200,49 @@ async def test_sync_model_from_bucket_subject_real_store(tmp_path):
         assert resp["data"]["local_path"].endswith("m.gguf")
         assert worker_store.lookup("acme/sync-model") is not None
         await worker.drain()
+
+
+@async_test
+async def test_multi_worker_fanout_real_models(tmp_path):
+    """BASELINE config 5 shape: Object Store fan-out + concurrent chat load
+    across two queue-group workers, each running a real engine."""
+    import asyncio
+
+    async with E2E() as h:
+        src = tmp_path / "fan.gguf"
+        build_tiny_gguf(src)
+        pub = ModelStore(tmp_path / "pub", objstore=h.objstore)
+        pub.import_file(src, "acme/fan")
+        await pub.publish_model("acme/fan")
+
+        workers = []
+        for i in range(2):
+            store = ModelStore(tmp_path / f"w{i}", objstore=h.objstore)
+            w = Worker(WorkerConfig(nats_url=h.broker.url), LocalRegistry(store, dtype="float32"))
+            await w.start()
+            workers.append(w)
+
+        # both workers pull via the queue group until each has the model
+        # (queue groups load-balance, so loop until both caches are warm)
+        for _ in range(20):
+            resp = await h.req("pull_model", {"identifier": "acme/fan"})
+            assert resp["ok"], resp
+            if all((tmp_path / f"w{i}" / "acme" / "fan").is_dir() for i in range(2)):
+                break
+        assert all((tmp_path / f"w{i}" / "acme" / "fan").is_dir() for i in range(2))
+
+        body = {
+            "model": "acme/fan",
+            "messages": [{"role": "user", "content": "fan out"}],
+            "max_tokens": 4,
+            "temperature": 0.0,
+        }
+        results = await asyncio.gather(*[h.req("chat_model", body, timeout=90.0) for _ in range(10)])
+        assert all(r["ok"] for r in results), results
+        # identical greedy output regardless of which worker served it
+        texts = {r["data"]["response"]["choices"][0]["message"]["content"] for r in results}
+        assert len(texts) == 1
+        served = [w._requests_total for w in workers]
+        assert sum(served) >= 10
+        for w in workers:
+            await w.drain()
