@@ -219,14 +219,20 @@ _DEQUANT = {
 
 
 def quantize(x: np.ndarray, t: GGMLType) -> bytes:
-    """Encode a float array as storage type ``t``. Flattens row-major."""
-    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    """Encode an array as storage type ``t``. Flattens row-major."""
     if t in _PLAIN_DTYPES:
-        return np.ascontiguousarray(x.astype(_PLAIN_DTYPES[t])).tobytes()
+        # encode straight from the source dtype: a float32 round-trip would
+        # silently corrupt I32/I64 values above 2**24
+        arr = np.ascontiguousarray(np.asarray(x).reshape(-1))
+        return np.ascontiguousarray(arr.astype(_PLAIN_DTYPES[t])).tobytes()
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
     if t == GGMLType.BF16:
         u = x.view(np.uint32)
-        # round-to-nearest-even on the dropped 16 bits
+        # round-to-nearest-even on the dropped 16 bits; NaN passes through as
+        # the canonical quiet NaN (the +0x7FFF carry would otherwise turn
+        # some NaN encodings into +/-Inf)
         rounded = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype("<u2")
+        rounded = np.where(np.isnan(x), np.uint16(0x7FC0), rounded).astype("<u2")
         return rounded.tobytes()
     fn = _QUANT.get(t)
     if fn is None:

@@ -58,8 +58,21 @@ def _attention_block(
 
     if cfg.use_flash_attention and t > 1:
         # prefill at start_pos 0: the cache holds exactly k/v, so causal
-        # attention over the fresh block equals attention over the cache
-        out = flash_attention_auto(q, k, v, cfg.attn_scale)
+        # attention over the fresh block equals attention over the cache.
+        # At start_pos > 0 (chunked prefill) the fresh block misses earlier
+        # cache entries, so fall back to full-cache attention — lax.cond
+        # executes only the taken branch per step.
+        def _flash(ops):
+            q, _, _, k, v = ops
+            return flash_attention_auto(q, k, v, cfg.attn_scale)
+
+        def _dense(ops):
+            q, kc, vc, _, _ = ops
+            return gqa_attention(q, kc.astype(q.dtype), vc.astype(q.dtype), mask, cfg.attn_scale)
+
+        out = jax.lax.cond(
+            jnp.all(start_pos == 0), _flash, _dense, (q, k_cache, v_cache, k, v)
+        )
     else:
         k_att, v_att = k_cache, v_cache
         if attn_window is not None and attn_window < k_cache.shape[1]:

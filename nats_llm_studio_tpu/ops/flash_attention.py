@@ -76,8 +76,12 @@ def flash_attention(
     b, t, hq, d = q.shape
     hkv = k.shape[2]
     group = hq // hkv
-    block_q = min(block_q, max(t, 8))
-    block_k = min(block_k, max(t, 8))
+    # clamp to the sequence, then round up to the dtype's native sublane
+    # tile (f32: 8 rows, bf16/f16: 16): Mosaic rejects ragged tile heights
+    # on real TPU (invisible in CPU interpret-mode tests)
+    mult = 8 if q.dtype.itemsize >= 4 else 16
+    block_q = -(-min(block_q, max(t, mult)) // mult) * mult
+    block_k = -(-min(block_k, max(t, mult)) // mult) * mult
 
     pad_q = (-t) % block_q
     pad_k = (-t) % block_k

@@ -122,6 +122,10 @@ class ContinuousBatcher:
         self._thread: threading.Thread | None = None
         self._started = False
         self._stopping = False
+        # serializes submit's stopped-check+enqueue against stop's
+        # stopping-flag+sentinel so no request can slip into the inbox after
+        # the final drain (submit would otherwise hang forever)
+        self._submit_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -135,15 +139,25 @@ class ContinuousBatcher:
     def stop(self) -> None:
         if not self._started or self._stopping:
             return
-        self._stopping = True
-        self._inbox.put(None)
+        with self._submit_lock:
+            self._stopping = True
+            self._inbox.put(None)
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+        # anything enqueued between the owner thread's final drain and here
+        self._drain_all("shutdown")
 
     # -- client API ----------------------------------------------------------
 
-    async def submit(self, prompt_ids: list[int], sp: SamplingParams) -> AsyncIterator[int]:
-        """Yield generated token ids for one request."""
+    async def submit(
+        self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
+    ) -> AsyncIterator[int]:
+        """Yield generated token ids for one request.
+
+        When ``info`` is given, the batcher's end reason ("stop" / "length" /
+        "shutdown") is recorded in ``info["finish_reason"]`` so callers report
+        cache-capacity terminations truthfully instead of re-deriving from
+        token counts."""
         if not self._started:
             self.start()
         if not prompt_ids:
@@ -156,12 +170,17 @@ class ContinuousBatcher:
             loop=asyncio.get_running_loop(),
             out=asyncio.Queue(),
         )
-        self._inbox.put(req)
+        with self._submit_lock:
+            if self._stopping:
+                raise RuntimeError("batcher is stopped")
+            self._inbox.put(req)
         while True:
             kind, value = await req.out.get()
             if kind == "tok":
                 yield value
             elif kind == "end":
+                if info is not None:
+                    info["finish_reason"] = value
                 return
             else:
                 raise value
@@ -241,7 +260,7 @@ class ContinuousBatcher:
                     break
                 block = False
                 if item is None:
-                    self._drain_all("shutdown")
+                    self._drain_all("shutdown", waitlist)
                     return
                 waitlist.append(item)
             # admit as many waiters as there are free slots
@@ -305,7 +324,9 @@ class ContinuousBatcher:
             return False
         return True
 
-    def _drain_all(self, reason: str) -> None:
+    def _drain_all(self, reason: str, waitlist: list[_Request] = ()) -> None:
+        for req in waitlist:
+            req.emit("end", reason)
         for i, req in enumerate(self._slots):
             if req is not None:
                 req.emit("end", reason)

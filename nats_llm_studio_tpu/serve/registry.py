@@ -118,8 +118,9 @@ class JaxChatEngine(ChatEngine):
         t0 = time.perf_counter()
         toks: list[int] = []
         emitted = 0
+        end_info: dict = {}
         try:
-            async for tok_id in self.batcher.submit(prompt_ids, sp):
+            async for tok_id in self.batcher.submit(prompt_ids, sp, info=end_info):
                 if not toks:
                     stats.ttft_s = time.perf_counter() - t0
                 toks.append(tok_id)
@@ -157,7 +158,14 @@ class JaxChatEngine(ChatEngine):
                     }
                 ],
             }
-        finish = "length" if stats and stats.completion_tokens >= sp.max_tokens else "stop"
+        # the batcher's end reason covers max_tokens *and* cache-capacity
+        # terminations ("length"); a worker-drain truncation surfaces as an
+        # error when nothing was generated, or an explicit "shutdown"
+        # finish_reason on a partial completion — never as a clean "stop"
+        reason = end_info.get("finish_reason", "stop")
+        if reason == "shutdown" and not toks:
+            raise EngineError("worker draining, retry on another worker")
+        finish = reason if reason in ("length", "shutdown") else "stop"
         yield self._completion(text, len(prompt_ids), len(toks), finish, stats)
 
     def info(self) -> dict:

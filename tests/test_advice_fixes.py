@@ -1,0 +1,154 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. shutdown must end waitlisted (admitted-to-inbox but not yet slotted)
+   requests, not just active slots, so library callers never hang;
+2. the flash prefill path must stay correct at start_pos > 0 (chunked
+   prefill) by falling back to full-cache attention;
+3. integer GGUF storage types must round-trip values above 2**24 and BF16
+   must pass NaN through;
+4. flash tile sizes must come out as multiples of 8 even for ragged T;
+5. the batcher's end reason must reach the caller (finish_reason fidelity).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.engine.generator import SamplingParams
+from nats_llm_studio_tpu.gguf.constants import GGMLType
+from nats_llm_studio_tpu.gguf.quants import dequantize, quantize
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+from conftest import async_test
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# -- 1: shutdown drains the waitlist ----------------------------------------
+
+
+@async_test
+async def test_shutdown_ends_waitlisted_requests(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=1, max_seq_len=64, buckets=[8, 64])
+    sp = SamplingParams(temperature=0.0, max_tokens=50)
+    first_tok = asyncio.Event()
+    reasons: dict[int, str] = {}
+
+    async def run(i):
+        info: dict = {}
+        async for _ in b.submit([1 + i, 2, 3], sp, info=info):
+            first_tok.set()
+        reasons[i] = info.get("finish_reason", "missing")
+
+    # one request occupies the single slot; two more sit in the waitlist
+    tasks = [asyncio.create_task(run(i)) for i in range(3)]
+    await asyncio.wait_for(first_tok.wait(), timeout=30)
+    await asyncio.to_thread(b.stop)
+    # every submit must terminate — before the fix, waitlisted callers hung
+    await asyncio.wait_for(asyncio.gather(*tasks), timeout=10)
+    assert set(reasons) == {0, 1, 2}
+    assert "shutdown" in reasons.values()
+
+
+@async_test
+async def test_submit_after_stop_raises(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=1, max_seq_len=64, buckets=[8, 64])
+    b.start()
+    await asyncio.to_thread(b.stop)
+    with pytest.raises(RuntimeError):
+        async for _ in b.submit([1, 2], SamplingParams(max_tokens=2)):
+            pass
+
+
+# -- 2: chunked prefill correctness with flash enabled ----------------------
+
+
+def test_chunked_prefill_matches_full_with_flash():
+    cfg = ModelConfig.tiny(n_layers=2, use_flash_attention=True)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    toks = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]], jnp.int32)
+    zero = jnp.zeros((1,), jnp.int32)
+
+    k, v = make_cache(cfg, 1, 32)
+    want, _, _ = forward(params, cfg, toks, k, v, zero)
+
+    k, v = make_cache(cfg, 1, 32)
+    _, k, v = forward(params, cfg, toks[:, :8], k, v, zero)
+    got_tail, _, _ = forward(params, cfg, toks[:, 8:], k, v, jnp.full((1,), 8, jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got_tail), np.asarray(want[:, 8:]), rtol=5e-3, atol=5e-3
+    )
+
+
+# -- 3: quantize fidelity ----------------------------------------------------
+
+
+def test_quantize_int_types_exact_above_2_24():
+    big = np.asarray([2**24 + 1, -(2**31) + 7, 2**24 + 3, 12345, -1, 0, 77, 2**30 + 1],
+                     dtype=np.int64)
+    for t in (GGMLType.I32, GGMLType.I64):
+        out = dequantize(quantize(big, t), t, big.size)
+        np.testing.assert_array_equal(np.asarray(out, dtype=np.int64), big)
+
+
+def test_quantize_bf16_nan_passthrough():
+    x = np.asarray([1.0, np.nan, -2.5, np.inf, -np.inf, 0.0], dtype=np.float32)
+    out = dequantize(quantize(x, GGMLType.BF16), GGMLType.BF16, x.size)
+    assert np.isnan(out[1])
+    np.testing.assert_array_equal(out[[0, 2, 3, 4, 5]], x[[0, 2, 3, 4, 5]])
+
+
+# -- 4: flash tiles stay multiples of 8 -------------------------------------
+
+
+def test_flash_ragged_t_uses_aligned_tiles():
+    from nats_llm_studio_tpu.ops.flash_attention import flash_attention
+    from nats_llm_studio_tpu.ops.layers import gqa_attention
+
+    b, t, h, d = 1, 100, 2, 16  # t=100 used to clamp block_q to 100 (not %8)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, d), jnp.float32)
+    pos = jnp.arange(t)
+    mask = (pos[None, None, :] <= pos[None, :, None]).repeat(b, axis=0)
+    want = gqa_attention(q, k, v, mask, d**-0.5)
+    got = flash_attention(q, k, v, d**-0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+# -- 5: finish_reason fidelity ----------------------------------------------
+
+
+@async_test
+async def test_finish_reason_propagates(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=16, buckets=[8, 16])
+    try:
+        # cache capacity: prompt 10 + decode hits max_seq 16 before max_tokens
+        info: dict = {}
+        toks = [t async for t in b.submit(list(range(1, 11)), SamplingParams(
+            temperature=0.0, max_tokens=100), info=info)]
+        assert info["finish_reason"] == "length"
+        assert 0 < len(toks) < 100
+
+        # stop token
+        first = toks[0] if toks else 1
+        info2: dict = {}
+        _ = [t async for t in b.submit(list(range(1, 11)), SamplingParams(
+            temperature=0.0, max_tokens=100, stop_ids=frozenset({first})), info=info2)]
+        assert info2["finish_reason"] == "stop"
+    finally:
+        b.stop()
