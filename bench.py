@@ -29,7 +29,7 @@ def _sync(x) -> None:
 
 from nats_llm_studio_tpu.engine.sampling import sample
 from nats_llm_studio_tpu.models.config import ModelConfig
-from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+from nats_llm_studio_tpu.models.llama import ensure_lm_head, forward, init_params, make_cache
 
 NORTH_STAR_TOK_S = 2000.0
 
@@ -48,7 +48,22 @@ def main() -> None:
         seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
         steps = int(os.environ.get("BENCH_STEPS", "128"))
 
-    params = init_params(cfg, jax.random.PRNGKey(0))
+    quant = os.environ.get("BENCH_QUANT", "int8" if not tiny else "none")
+    params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
+    if quant == "int8":
+        # quantize on device: per-leaf absmax/round is fast there and avoids
+        # a 5 GB host round-trip
+        from nats_llm_studio_tpu.ops.wquant import quantizable, quantize_weight
+
+        def q(path, leaf):
+            return quantize_weight(leaf, device=True) if quantizable(path) else leaf
+
+        params = {
+            "embed": params["embed"],
+            "out_norm": params["out_norm"],
+            "lm_head": q("lm_head", params["lm_head"]),
+            "blocks": {k: q(k, v) for k, v in params["blocks"].items()},
+        }
 
     fwd = partial(forward, cfg=cfg)
 
@@ -124,7 +139,8 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "granite2b_bf16_decode_tok_s" + (".tiny" if tiny else f".b{batch}"),
+                "metric": f"granite2b_{quant if quant != 'none' else cfg.dtype}_decode_tok_s"
+                + (".tiny" if tiny else f".b{batch}"),
                 "value": round(tok_s, 1),
                 "unit": "tok/s/chip",
                 "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 3),
@@ -132,6 +148,7 @@ def main() -> None:
                     "batch": batch,
                     "prompt_len": prompt_len,
                     "decode_steps": steps,
+                    "quant": quant,
                     "prefill_s": round(prefill_s, 4),
                     "host_loop_tok_s": round(host_tok_s, 1),
                     "platform": jax.devices()[0].platform,

@@ -39,6 +39,9 @@ class WorkerConfig:
     mesh_shape: str = field(default_factory=lambda: _env("TPU_MESH", ""))  # e.g. "tp=8" or "dp=2,tp=4"
     max_batch_slots: int = field(default_factory=lambda: int(_env("MAX_BATCH_SLOTS", "8")))
     max_seq_len: int = field(default_factory=lambda: int(_env("MAX_SEQ_LEN", "4096")))
+    # "none" (serve in cfg dtype) or "int8" (weight-only per-channel int8:
+    # halves HBM weight traffic and fits 70B-class models on a v5e-8)
+    quant_mode: str = field(default_factory=lambda: _env("TPU_QUANT", "none"))
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

@@ -73,7 +73,8 @@ async def _run_serve(args: argparse.Namespace) -> None:
     nc = await connect(cfg.nats_url, name="store-client")
     store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket)
     registry = LocalRegistry(
-        store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots
+        store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots,
+        quant=cfg.quant_mode,
     )
     worker = Worker(cfg, registry)
     await worker.start()

@@ -37,6 +37,10 @@ class ModelConfig:
     # Pallas flash-attention for prefill (requires prefill at start_pos 0,
     # which the engine guarantees); decode keeps the fused XLA path
     use_flash_attention: bool = False
+    # MoE dispatch: routed (sparse scatter/gather + optional ep shard_map,
+    # parallel/moe.py) vs dense reference (every expert computes every token)
+    use_routed_moe: bool = False
+    moe_capacity_factor: float = 2.0
 
     @property
     def attn_scale(self) -> float:

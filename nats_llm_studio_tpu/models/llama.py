@@ -19,8 +19,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.flash_attention import flash_attention_auto
-from ..ops.layers import apply_rope, gqa_attention, rms_norm, rope_cos_sin, swiglu
+from ..ops.flash_attention import flash_attention_auto, flash_decode_auto
+from ..ops.layers import (
+    apply_rope,
+    gqa_attention_hmajor,
+    rms_norm,
+    rope_cos_sin,
+    swiglu,
+)
+from ..ops.wquant import mm, q_einsum
 from .config import ModelConfig
 
 Params = dict[str, Any]
@@ -45,16 +52,18 @@ def _attention_block(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q = (x @ p["wq"]).reshape(b, t, hq, d)
-    k = (x @ p["wk"]).reshape(b, t, hkv, d)
-    v = (x @ p["wv"]).reshape(b, t, hkv, d)
+    q = mm(x, p["wq"]).reshape(b, t, hq, d)
+    k = mm(x, p["wk"]).reshape(b, t, hkv, d)
+    v = mm(x, p["wv"]).reshape(b, t, hkv, d)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
     zero = jnp.zeros((), start_pos.dtype)
-    write = jax.vmap(lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, zero, zero)))
-    k_cache = write(k_cache, k.astype(k_cache.dtype), start_pos)
-    v_cache = write(v_cache, v.astype(v_cache.dtype), start_pos)
+    # cache is heads-major [B, Hkv, S, D]: per-row update [Hkv, T, D] lands
+    # at sequence offset s in each head's contiguous slab
+    write = jax.vmap(lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (zero, s, zero)))
+    k_cache = write(k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype), start_pos)
+    v_cache = write(v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), start_pos)
 
     if cfg.use_flash_attention and t > 1:
         # prefill at start_pos 0: the cache holds exactly k/v, so causal
@@ -68,22 +77,33 @@ def _attention_block(
 
         def _dense(ops):
             q, kc, vc, _, _ = ops
-            return gqa_attention(q, kc.astype(q.dtype), vc.astype(q.dtype), mask, cfg.attn_scale)
+            return gqa_attention_hmajor(
+                q, kc.astype(q.dtype), vc.astype(q.dtype), mask, cfg.attn_scale
+            )
 
         out = jax.lax.cond(
             jnp.all(start_pos == 0), _flash, _dense, (q, k_cache, v_cache, k, v)
         )
+    elif cfg.use_flash_attention and t == 1:
+        # decode: the cache row at start_pos now holds the fresh k/v, so the
+        # token attends to cache[:start_pos+1]; the kernel streams the cache
+        # once per (batch, kv head) and skips tiles beyond the live prefix
+        out = flash_decode_auto(q[:, 0], k_cache, v_cache, start_pos, cfg.attn_scale)[
+            :, None
+        ]
     else:
         k_att, v_att = k_cache, v_cache
-        if attn_window is not None and attn_window < k_cache.shape[1]:
+        if attn_window is not None and attn_window < k_cache.shape[2]:
             # decode HBM traffic is dominated by reading the cache; a static
             # window bucket >= the longest live sequence reads only the
             # active prefix instead of all S_max slots
-            k_att = jax.lax.slice_in_dim(k_cache, 0, attn_window, axis=1)
-            v_att = jax.lax.slice_in_dim(v_cache, 0, attn_window, axis=1)
+            k_att = jax.lax.slice_in_dim(k_cache, 0, attn_window, axis=2)
+            v_att = jax.lax.slice_in_dim(v_cache, 0, attn_window, axis=2)
             mask = jax.lax.slice_in_dim(mask, 0, attn_window, axis=2)
-        out = gqa_attention(q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask, cfg.attn_scale)
-    return out.reshape(b, t, hq * d) @ p["wo"], k_cache, v_cache
+        out = gqa_attention_hmajor(
+            q, k_att.astype(q.dtype), v_att.astype(q.dtype), mask, cfg.attn_scale
+        )
+    return mm(out.reshape(b, t, hq * d), p["wo"]), k_cache, v_cache
 
 
 def _moe_ffn(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
@@ -97,9 +117,9 @@ def _moe_ffn(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32) * top_w[..., None], axis=-2
     )  # dense combine weights [B,T,E]
-    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, p["w_gate_e"]))
-    up = jnp.einsum("btd,edf->btef", x, p["w_up_e"])
-    expert_out = jnp.einsum("btef,efd->bted", gate * up, p["w_down_e"])
+    gate = jax.nn.silu(q_einsum("btd,edf->btef", x, p["w_gate_e"]))
+    up = q_einsum("btd,edf->btef", x, p["w_up_e"])
+    expert_out = q_einsum("btef,efd->bted", gate * up, p["w_down_e"])
     return jnp.einsum("bted,bte->btd", expert_out, combine.astype(x.dtype))
 
 
@@ -107,10 +127,11 @@ def forward(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,  # int32 [B, T]
-    k_cache: jax.Array,  # [L, B, S, Hkv, D]
+    k_cache: jax.Array,  # [L, B, Hkv, S, D] (heads-major, see make_cache)
     v_cache: jax.Array,
     start_pos: jax.Array,  # int32 [B] — write offset per row (0 for prefill)
     attn_window: int | None = None,  # static: attend to cache[:window] only
+    mesh=None,  # static: enables the expert-parallel routed-MoE shard_map
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache).
 
@@ -122,7 +143,7 @@ def forward(
     prefix.
     """
     b, t = tokens.shape
-    s_max = k_cache.shape[2]
+    s_max = k_cache.shape[3]
     positions = start_pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     key_pos = jnp.arange(s_max, dtype=jnp.int32)
@@ -139,7 +160,12 @@ def forward(
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)
         if cfg.is_moe:
-            ffn_out = _moe_ffn(h, p, cfg)
+            if cfg.use_routed_moe:
+                from ..parallel.moe import routed_moe_ffn
+
+                ffn_out = routed_moe_ffn(h, p, cfg, mesh, cfg.moe_capacity_factor)
+            else:
+                ffn_out = _moe_ffn(h, p, cfg)
         else:
             ffn_out = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
         x = x + ffn_out * cfg.residual_scale
@@ -150,18 +176,33 @@ def forward(
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    logits = (x @ lm_head).astype(jnp.float32) * cfg.logit_scale
+    logits = mm(x, lm_head).astype(jnp.float32) * cfg.logit_scale
     return logits, k_cache, v_cache
+
+
+def ensure_lm_head(params: Params) -> Params:
+    """Materialize a contiguous [d_model, vocab] lm_head for tied-embedding
+    models. forward() falls back to ``embed.T`` when absent, which is correct
+    but leaves the output projection reading a transposed view every decode
+    step; serving paths call this once at load so the hot loop gets the
+    matmul-native layout (and the quantizer can see the leaf)."""
+    if "lm_head" in params:
+        return params
+    params = dict(params)
+    params["lm_head"] = jnp.swapaxes(params["embed"], 0, 1)  # eager: materializes
+    return params
 
 
 def make_cache(
     cfg: ModelConfig, batch: int, seq_len: int | None = None, dtype: str | None = None
 ) -> tuple[jax.Array, jax.Array]:
-    """Zeroed KV cache pair, layout [L, B, S, Hkv, D] (SURVEY.md §5: heads on
-    a shardable axis so a TP axis annotates Hkv and a later sequence/ring axis
-    annotates S without relayout)."""
+    """Zeroed KV cache pair, layout [L, B, Hkv, S, D] — heads-major so each
+    (batch, head) slab is contiguous: decode attention DMA-streams the cache
+    sequentially (ops.flash_attention.flash_decode), the TP axis annotates
+    Hkv, and a later sequence/ring axis annotates S without relayout
+    (SURVEY.md §5)."""
     s = seq_len or cfg.max_seq_len
-    shape = (cfg.n_layers, batch, s, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, s, cfg.head_dim)
     dt = jnp.dtype(dtype or cfg.dtype)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 

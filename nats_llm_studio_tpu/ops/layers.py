@@ -69,10 +69,38 @@ def gqa_attention(
     return out.reshape(b, t, hq, d)
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+def gqa_attention_hmajor(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array,
+    scale: float,
+) -> jax.Array:
+    """gqa_attention over a heads-major cache.
+
+    q: [B, T, Hq, D]; k, v: [B, Hkv, S, D] (the KV-cache layout — per-head
+    slabs contiguous so decode DMA streams sequentially); mask: bool
+    [B, T, S]. Returns [B, T, Hq, D] in q.dtype.
+    """
+    b, t, hq, d = q.shape
+    hkv, s = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, t, hkv, g, d)
+    logits = jnp.einsum("bthgd,bhsd->bhgts", qg, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgts,bhsd->bthgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, hq, d)
+
+
+def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
     """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ).
 
-    Weights are [d_in, d_out] row-major so the matmuls are plain ``x @ w``.
+    Weights are [d_in, d_out] row-major (plain ``x @ w``), stored bf16 or
+    weight-only int8 (ops.wquant.QTensor).
     """
-    gate = jax.nn.silu(x @ w_gate)
-    return (gate * (x @ w_up)) @ w_down
+    from .wquant import mm
+
+    gate = jax.nn.silu(mm(x, w_gate))
+    return mm(gate * mm(x, w_up), w_down)

@@ -1,0 +1,128 @@
+"""Weight-only int8 quantization for serving.
+
+Decode throughput is bound by streaming the weights from HBM once per step
+(SURVEY.md §7 hard part #5); storing matmul weights as int8 with a
+per-output-channel scale halves that traffic vs bf16 and is what makes
+Llama-3-70B fit on a v5e-8 (BASELINE.md config 3: 8 x 16 GB HBM cannot hold
+140 GB of bf16). The reference gets the same capability from llama.cpp's
+quantized GGUF kernels inside LM Studio (/root/reference/README.md:3-7);
+here it is a first-class device representation, not a file format.
+
+``QTensor`` is a pytree (int8 codes + broadcastable scale), so quantized
+params flow through jit / lax.scan / shard_map unchanged — scan slices the
+leading [L] axis off both leaves. ``mm``/``q_einsum`` dequantize on the fly:
+XLA fuses convert(s8->bf16)*scale into the matmul's operand read, so HBM
+moves int8 bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QTensor:
+    """Symmetric per-output-channel int8 weight: ``w ≈ q * s``.
+
+    q: int8, the original weight shape [..., in, out]
+    s: f32, [..., 1, out] — broadcastable over the contraction axis
+    """
+
+    q: jax.Array
+    s: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def dequant(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+
+
+def quantize_weight(w: np.ndarray | jax.Array, device: bool = False) -> QTensor:
+    """Symmetric absmax int8 over the contraction (second-to-last) axis.
+
+    Host-side NumPy by default so the streaming 70B loader can quantize one
+    tensor at a time without touching the device; ``device=True`` runs the
+    same math in jnp for already-placed arrays.
+    """
+    xp = jnp if device else np
+    w = w if device else np.asarray(w, dtype=np.float32)
+    amax = xp.max(xp.abs(w.astype(xp.float32) if device else w), axis=-2, keepdims=True)
+    s = amax / 127.0
+    safe = xp.where(s == 0, 1.0, s)
+    q = xp.clip(xp.round(w / safe), -127, 127).astype(xp.int8)
+    return QTensor(q=q, s=safe.astype(xp.float32))
+
+
+def mm(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for plain arrays or QTensor (dequant-in-matmul)."""
+    if isinstance(w, QTensor):
+        y = jnp.matmul(x, w.q.astype(x.dtype))
+        return y * w.s.astype(x.dtype)
+    return x @ w
+
+
+def q_einsum(spec: str, x: jax.Array, w) -> jax.Array:
+    """``einsum(spec, x, w)`` with QTensor support.
+
+    Requires the weight's contraction axis to be its second-to-last (where
+    the scale has extent 1). The scale is permuted/broadcast to the output
+    label order, so any output layout works ("btd,edf->btef",
+    "ecd,edf->ecf", ...).
+    """
+    if not isinstance(w, QTensor):
+        return jnp.einsum(spec, x, w)
+    y = jnp.einsum(spec, x, w.q.astype(x.dtype))
+    ins, out = spec.split("->")
+    wsub = ins.split(",")[1]
+    kept = [l for l in out if l in wsub]
+    # the reduced labels all have extent 1 in the scale, so this einsum is a
+    # squeeze+permute into output label order
+    s = jnp.einsum(f"{wsub}->{''.join(kept)}", w.s)
+    shape = [s.shape[kept.index(l)] if l in kept else 1 for l in out]
+    return y * s.reshape(shape).astype(x.dtype)
+
+
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+     "w_gate_e", "w_up_e", "w_down_e", "lm_head"}
+)
+
+
+def quantizable(key: str) -> bool:
+    """Whether a params-pytree leaf (by last path segment) should be int8.
+
+    Norms and the router stay high precision (tiny, accuracy-critical); the
+    embedding stays bf16 because it is read by gather, not matmul.
+    """
+    return key.rsplit(".", 1)[-1] in _QUANT_KEYS
+
+
+def quantize_params(params: dict, device: bool = False) -> dict:
+    """Quantize every eligible leaf of a materialized params pytree."""
+
+    def walk(node: dict, prefix: str = "") -> dict:
+        out = {}
+        for k, v in node.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out[k] = walk(v, f"{path}.")
+            elif quantizable(path) and not isinstance(v, QTensor):
+                out[k] = quantize_weight(
+                    v if device else np.asarray(v), device=device
+                )
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)

@@ -24,7 +24,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.llama import _rope_deinterleave
-from .sharding import param_sharding_rules
+from ..ops.wquant import QTensor, quantizable, quantize_weight
+from .sharding import param_sharding_rules, scale_spec
 
 log = logging.getLogger(__name__)
 
@@ -39,11 +40,20 @@ def _place(arr: np.ndarray, mesh: Mesh, spec: P, dtype) -> jax.Array:
 
 
 def load_params_sharded(
-    reader, cfg: ModelConfig, mesh: Mesh, dtype: str | None = None
+    reader, cfg: ModelConfig, mesh: Mesh, dtype: str | None = None,
+    quant: str = "none",
 ) -> dict[str, Any]:
     """Build the stacked-params pytree directly on the mesh, one tensor at a
-    time. Same tensor-name contract as models.llama.load_params_from_gguf."""
+    time. Same tensor-name contract as models.llama.load_params_from_gguf.
+
+    ``quant="int8"`` re-quantizes each matmul weight to symmetric
+    per-output-channel int8 on the host *before* placement, so device HBM
+    holds int8 + scales — the path that fits Llama-3-70B on a v5e-8
+    (BASELINE.md config 3) and halves decode weight traffic.
+    """
     dt = jnp.dtype(dtype or cfg.dtype)
+    if quant not in ("none", "int8"):
+        raise ValueError(f"unknown quant mode {quant!r}")
     rules = param_sharding_rules(mesh)
 
     def t(name: str) -> np.ndarray:
@@ -52,22 +62,42 @@ def load_params_sharded(
     def mat(name: str) -> np.ndarray:
         return np.ascontiguousarray(t(name).T)
 
+    def place_leaf(key: str, arr: np.ndarray, spec: P, layered: bool):
+        """Host tensor -> device leaf (bf16 array or int8 QTensor)."""
+        w_sh = _layer_sharding(mesh, spec) if layered else NamedSharding(mesh, spec)
+        if quant == "int8" and quantizable(key):
+            qt = quantize_weight(arr)
+            s_spec = scale_spec(P(*spec[1:])) if layered else scale_spec(spec)
+            return QTensor(
+                q=jax.device_put(jnp.asarray(qt.q), w_sh),
+                s=jax.device_put(jnp.asarray(qt.s), NamedSharding(mesh, s_spec)),
+            )
+        return jax.device_put(jnp.asarray(arr, dt), w_sh)
+
     params: dict[str, Any] = {
         "embed": _place(t("token_embd.weight"), mesh, rules["embed"], dt),
         "out_norm": _place(t("output_norm.weight"), mesh, rules["out_norm"], dt),
     }
     if "output.weight" in reader.tensors:
-        params["lm_head"] = _place(mat("output.weight"), mesh, rules["lm_head"], dt)
+        params["lm_head"] = place_leaf(
+            "lm_head", mat("output.weight"), rules["lm_head"], layered=False
+        )
+    else:
+        # tied embeddings: materialize the [d, vocab] head now (contiguous,
+        # shardable, quantizable) instead of transposing embed every step
+        params["lm_head"] = place_leaf(
+            "lm_head", np.ascontiguousarray(t("token_embd.weight").T),
+            rules["lm_head"], layered=False,
+        )
 
     # stacked per-layer leaves: place each layer slice with the slice
     # sharding, then stack on-device (jnp.stack of committed sharded arrays
     # stays on device; the host copy of each slice dies right after placement)
-    per_layer: dict[str, list[jax.Array]] = {}
+    per_layer: dict[str, list] = {}
 
     def push(key: str, arr: np.ndarray) -> None:
         spec = rules[f"blocks.{key}"]
-        sh = _layer_sharding(mesh, spec)
-        per_layer.setdefault(key, []).append(jax.device_put(jnp.asarray(arr, dt), sh))
+        per_layer.setdefault(key, []).append(place_leaf(key, arr, spec, layered=True))
 
     for i in range(cfg.n_layers):
         pre = f"blk.{i}"
@@ -89,10 +119,17 @@ def load_params_sharded(
         if i % 8 == 7:
             gc.collect()  # drop dequant temporaries promptly on big models
 
-    blocks: dict[str, jax.Array] = {}
+    blocks: dict[str, Any] = {}
     for key, slices in per_layer.items():
         spec = rules[f"blocks.{key}"]
-        stacked = jnp.stack(slices)
-        blocks[key] = jax.device_put(stacked, NamedSharding(mesh, spec))
+        if isinstance(slices[0], QTensor):
+            blocks[key] = QTensor(
+                q=jax.device_put(jnp.stack([s.q for s in slices]),
+                                 NamedSharding(mesh, spec)),
+                s=jax.device_put(jnp.stack([s.s for s in slices]),
+                                 NamedSharding(mesh, scale_spec(spec))),
+            )
+        else:
+            blocks[key] = jax.device_put(jnp.stack(slices), NamedSharding(mesh, spec))
     params["blocks"] = blocks
     return params

@@ -1,0 +1,105 @@
+"""Per-device HBM accounting for a sharded serving config.
+
+Answers "does this model fit this mesh?" *before* touching a device — the
+fail-fast the 70B-on-v5e-8 story needs (BASELINE.md config 3: 8 x 16 GB HBM;
+140 GB of bf16 weights only fit after weight-only int8). Mirrors
+``sharding.param_sharding_rules`` axis-for-axis: any change there must be
+reflected here (test_wquant.py pins the 70B budget).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..models.config import ModelConfig
+
+
+@dataclass
+class _Leaf:
+    shape: tuple[int, ...]
+    shard_axes: tuple[int, ...]  # which dims divide by (tp-or-ep) factors
+    itemsize: int
+    quantizable: bool = False
+
+
+def _leaves(cfg: ModelConfig, dtype_bytes: int) -> dict[str, _Leaf]:
+    d, hq, hkv, hd, ff, L, V = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.d_ff, cfg.n_layers, cfg.vocab_size,
+    )
+    out: dict[str, _Leaf] = {
+        "embed": _Leaf((V, d), (), dtype_bytes),
+        "out_norm": _Leaf((d,), (), dtype_bytes),
+        "lm_head": _Leaf((d, V), (1,), dtype_bytes, quantizable=True),
+        "blocks.attn_norm": _Leaf((L, d), (), dtype_bytes),
+        "blocks.ffn_norm": _Leaf((L, d), (), dtype_bytes),
+        "blocks.wq": _Leaf((L, d, hq * hd), (2,), dtype_bytes, True),
+        "blocks.wk": _Leaf((L, d, hkv * hd), (2,), dtype_bytes, True),
+        "blocks.wv": _Leaf((L, d, hkv * hd), (2,), dtype_bytes, True),
+        "blocks.wo": _Leaf((L, hq * hd, d), (1,), dtype_bytes, True),
+    }
+    if cfg.is_moe:
+        e = cfg.n_experts
+        out |= {
+            "blocks.router": _Leaf((L, d, e), (), dtype_bytes),
+            # dim1 divides by ep, the tp dim by tp (handled by caller factors)
+            "blocks.w_gate_e": _Leaf((L, e, d, ff), (1, 3), dtype_bytes, True),
+            "blocks.w_up_e": _Leaf((L, e, d, ff), (1, 3), dtype_bytes, True),
+            "blocks.w_down_e": _Leaf((L, e, ff, d), (1, 2), dtype_bytes, True),
+        }
+    else:
+        out |= {
+            "blocks.w_gate": _Leaf((L, d, ff), (2,), dtype_bytes, True),
+            "blocks.w_up": _Leaf((L, d, ff), (2,), dtype_bytes, True),
+            "blocks.w_down": _Leaf((L, ff, d), (1,), dtype_bytes, True),
+        }
+    return out
+
+
+def estimate_device_bytes(
+    cfg: ModelConfig,
+    mesh_shape: dict[str, int],
+    quant: str = "none",
+    batch: int = 8,
+    seq_len: int | None = None,
+    cache_dtype_bytes: int | None = None,
+) -> dict[str, int]:
+    """Estimated peak HBM bytes per device: params + KV cache + workspace.
+
+    ``mesh_shape`` e.g. {"tp": 8} or {"dp": 2, "ep": 4}. Sharded axes divide
+    by the product of the tensor-parallel-like factors exactly as
+    ``param_sharding_rules`` assigns them (tp for dense, ep x tp for experts).
+    """
+    dtype_bytes = 2 if cfg.dtype in ("bfloat16", "float16") else 4
+    tp = mesh_shape.get("tp", 1)
+    ep = mesh_shape.get("ep", 1)
+    dp = mesh_shape.get("dp", 1)
+    seq = seq_len or cfg.max_seq_len
+
+    params = 0
+    for name, leaf in _leaves(cfg, dtype_bytes).items():
+        n = 1
+        for dim in leaf.shape:
+            n *= dim
+        # divide by the mesh factor on each sharded axis. For experts the
+        # first sharded axis is ep, the second tp; for dense leaves it is tp.
+        factors = [ep, tp] if len(leaf.shard_axes) == 2 else [tp] * len(leaf.shard_axes)
+        for f in factors:
+            n //= f
+        if quant == "int8" and leaf.quantizable:
+            w_bytes = n  # int8 codes
+            # scale: one f32 per output channel (last axis), same sharding
+            scale_elems = n // leaf.shape[-2] if len(leaf.shape) >= 2 else 0
+            params += w_bytes + scale_elems * 4
+        else:
+            params += n * dtype_bytes
+
+    cb = cache_dtype_bytes or dtype_bytes
+    kv = 2 * cfg.n_layers * batch * seq * cfg.n_kv_heads * cfg.head_dim * cb
+    kv //= dp * tp  # batch on dp, kv heads on tp
+
+    # workspace: logits [B, V] f32 (vocab sharded on tp) + activations
+    # [B, T, d]-scale temporaries + collective buffers; a conservative pad
+    work = batch * cfg.vocab_size * 4 // tp + 64 * 2**20
+    total = params + kv + work
+    return {"params": params, "kv_cache": kv, "workspace": work, "total": total}

@@ -1,0 +1,129 @@
+"""Routed (sparse) MoE dispatch — the expert-parallel path.
+
+The dense-dispatch form in models/llama.py computes every expert for every
+token (E/k x wasted FLOPs — Mixtral top-2-of-8 does 4x extra work,
+VERDICT.md missing #3). This module routes instead: each token's hidden
+state is scattered into per-expert slot buffers of *static* capacity, each
+expert runs one batched SwiGLU over its slots, and results gather back with
+the routing weights. All shapes are static (XLA-friendly); token->slot
+movement is scatter/gather (O(N*k*D)), not the one-hot-matmul dispatch whose
+FLOPs explode at prefill token counts.
+
+Expert parallelism: under ``shard_map`` over the mesh's ``ep`` axis each
+shard owns E/P experts (weights arrive pre-sharded by
+``sharding.param_sharding_rules``), scatters the replicated tokens into its
+local slots, computes, and ``psum``s the combined output — the all-to-all of
+the reference's NCCL-style EP expressed as XLA collectives over ICI
+(SURVEY.md §7 hard part #4).
+
+Capacity: C = ceil(cf * k * N / E). Tokens overflowing an expert's C slots
+drop that expert's contribution (standard capacity-factor semantics; the
+routing weight mass is not renormalized). cf defaults high enough that
+drops require pathological routing skew.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..ops.wquant import q_einsum
+from .mesh import AXIS_EP
+
+
+def _capacity(n_tokens: int, cfg: ModelConfig, capacity_factor: float) -> int:
+    per_expert = capacity_factor * cfg.n_experts_used * n_tokens / cfg.n_experts
+    return max(1, math.ceil(per_expert))
+
+
+def _route(xf: jax.Array, router, cfg: ModelConfig, capacity: int):
+    """Top-k routing + slot assignment. Returns (top_w [N,k] f32,
+    slot [N,k] int32 — global slot id e*C + position, or the trash slot
+    E*C for capacity overflow)."""
+    n = xf.shape[0]
+    e, k = cfg.n_experts, cfg.n_experts_used
+    router_logits = q_einsum("nd,df->nf", xf, router).astype(jnp.float32)
+    top_w, top_idx = jax.lax.top_k(router_logits, k)  # [N,k]
+    top_w = jax.nn.softmax(top_w, axis=-1)
+
+    # position of assignment (n, j) within its expert, in (n-major, j-minor)
+    # order: running count of prior assignments to the same expert
+    flat_e = top_idx.reshape(-1)  # [N*k]
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)  # [N*k, E]
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1  # [N*k]
+    slot = jnp.where(pos < capacity, flat_e * capacity + pos, e * capacity)
+    return top_w, slot.reshape(n, k).astype(jnp.int32)
+
+
+def _expert_swiglu(xe: jax.Array, w_gate, w_up, w_down) -> jax.Array:
+    """Batched per-expert SwiGLU. xe: [E_local, C, D]."""
+    gate = jax.nn.silu(q_einsum("ecd,edf->ecf", xe, w_gate))
+    up = q_einsum("ecd,edf->ecf", xe, w_up)
+    return q_einsum("ecf,efd->ecd", gate * up, w_down)
+
+
+def routed_moe_ffn(
+    x: jax.Array,  # [B, T, D]
+    p: dict,  # router / w_gate_e / w_up_e / w_down_e (arrays or QTensor)
+    cfg: ModelConfig,
+    mesh: Mesh | None = None,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """Sparse top-k MoE FFN; expert-parallel when ``mesh`` has an ep axis."""
+    b, t, d = x.shape
+    n = b * t
+    e, k = cfg.n_experts, cfg.n_experts_used
+    cap = _capacity(n, cfg, capacity_factor)
+    xf = x.reshape(n, d)
+    top_w, slot = _route(xf, p["router"], cfg, cap)
+    top_w = top_w.astype(x.dtype)
+
+    ep = mesh.shape.get(AXIS_EP, 1) if mesh is not None else 1
+    if ep <= 1:
+        # single-shard: one global slot buffer (+1 trash row for drops)
+        buf = jnp.zeros((e * cap + 1, d), x.dtype)
+        buf = buf.at[slot.reshape(-1)].set(
+            jnp.repeat(xf, k, axis=0), mode="drop", unique_indices=True
+        )
+        ye = _expert_swiglu(
+            buf[: e * cap].reshape(e, cap, d), p["w_gate_e"], p["w_up_e"], p["w_down_e"]
+        ).reshape(e * cap, d)
+        ye = jnp.concatenate([ye, jnp.zeros((1, d), ye.dtype)])  # trash row -> 0
+        picked = ye[slot.reshape(-1)].reshape(n, k, d)
+        out = jnp.einsum("nkd,nk->nd", picked, top_w)
+        return out.reshape(b, t, d)
+
+    e_local = e // ep
+    espec = P(AXIS_EP, None, None)
+
+    def shard_fn(xf, top_w, slot, w_gate, w_up, w_down):
+        # xf/top_w/slot replicated; expert weights sharded on ep (leading E)
+        shard = jax.lax.axis_index(AXIS_EP)
+        lo = shard * e_local * cap
+        local = slot - lo  # [N,k] local slot id
+        # out-of-shard or trash assignments -> local trash row
+        local = jnp.where((local >= 0) & (local < e_local * cap), local, e_local * cap)
+        buf = jnp.zeros((e_local * cap + 1, d), xf.dtype)
+        buf = buf.at[local.reshape(-1)].set(
+            jnp.repeat(xf, k, axis=0), mode="drop", unique_indices=True
+        )
+        ye = _expert_swiglu(
+            buf[: e_local * cap].reshape(e_local, cap, d), w_gate, w_up, w_down
+        ).reshape(e_local * cap, d)
+        ye = jnp.concatenate([ye, jnp.zeros((1, d), ye.dtype)])
+        picked = ye[local.reshape(-1)].reshape(n, k, d)
+        part = jnp.einsum("nkd,nk->nd", picked, top_w)
+        return jax.lax.psum(part, AXIS_EP)
+
+    out = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), espec, espec, espec),
+        out_specs=P(),
+    )(xf, top_w, slot, p["w_gate_e"], p["w_up_e"], p["w_down_e"])
+    return out.reshape(b, t, d)

@@ -19,6 +19,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
+from ..ops.wquant import QTensor
 from .mesh import AXIS_DP, AXIS_EP, AXIS_TP
 
 
@@ -52,6 +53,15 @@ def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
     }
 
 
+def scale_spec(weight_spec: P) -> P:
+    """Spec for a QTensor's per-output-channel scale [..., 1, out]: same as
+    the weight's but with the contraction (second-to-last) axis unsharded —
+    the scale has extent 1 there."""
+    parts = list(weight_spec) + [None] * (2 - len(weight_spec))
+    parts[-2] = None
+    return P(*parts)
+
+
 def _flatten_keys(params: dict[str, Any], prefix: str = "") -> dict[str, Any]:
     out = {}
     for k, v in params.items():
@@ -73,6 +83,11 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
     def place(path: str, leaf):
         spec = rules.get(path, P())
+        if isinstance(leaf, QTensor):
+            return QTensor(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
+                s=jax.device_put(leaf.s, NamedSharding(mesh, scale_spec(spec))),
+            )
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     def walk(node: dict[str, Any], prefix: str = "") -> dict[str, Any]:
@@ -86,8 +101,8 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_spec(mesh: Mesh) -> P:
-    """KV cache [L, B, S, Hkv, D]: batch on dp, heads on tp."""
-    return P(None, _axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), None)
+    """KV cache [L, B, Hkv, S, D]: batch on dp, heads on tp."""
+    return P(None, _axis(mesh, AXIS_DP), _axis(mesh, AXIS_TP), None, None)
 
 
 def shard_cache(k_cache, v_cache, mesh: Mesh):

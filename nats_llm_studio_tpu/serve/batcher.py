@@ -79,7 +79,9 @@ class ContinuousBatcher:
         buckets: list[int] | None = None,
         mesh=None,
     ):
-        self.params = params
+        from ..models.llama import ensure_lm_head
+
+        self.params = ensure_lm_head(params)
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_seq = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
@@ -87,7 +89,7 @@ class ContinuousBatcher:
         self.mesh = mesh
         self.stats = BatcherStats()
 
-        fwd = partial(forward, cfg=cfg)
+        fwd = partial(forward, cfg=cfg, mesh=mesh)
 
         @jax.jit
         def prefill1(params, tokens, k1, v1):

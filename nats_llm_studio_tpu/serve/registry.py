@@ -196,12 +196,14 @@ class LocalRegistry(Registry):
         dtype: str | None = None,
         max_seq_len: int | None = None,
         max_batch_slots: int = 8,
+        quant: str = "none",
     ):
         self.store = store
         self.mesh = mesh
         self.dtype = dtype or ("float32" if jax.default_backend() == "cpu" else "bfloat16")
         self.max_seq_len = max_seq_len
         self.max_batch_slots = max_batch_slots
+        self.quant = quant
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
         self._requests = 0
@@ -274,6 +276,7 @@ class LocalRegistry(Registry):
         cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(
             dtype=self.dtype,
             use_flash_attention=jax.default_backend() == "tpu",  # prefill TTFT
+            use_routed_moe=True,  # sparse dispatch (parallel/moe.py)
         )
         tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
         quant = {t.ggml_type.name for t in reader.tensors.values()}
@@ -283,9 +286,16 @@ class LocalRegistry(Registry):
             from ..parallel.loader import load_params_sharded
 
             validate_mesh_for_config(self.mesh, cfg)
-            params = load_params_sharded(reader, cfg, self.mesh)
+            params = load_params_sharded(reader, cfg, self.mesh, quant=self.quant)
+        elif self.quant == "int8":
+            from ..models.llama import ensure_lm_head
+            from ..ops.wquant import quantize_params
+
+            params = quantize_params(ensure_lm_head(load_params_from_gguf(reader, cfg)))
         else:
-            params = load_params_from_gguf(reader, cfg)
+            from ..models.llama import ensure_lm_head
+
+            params = ensure_lm_head(load_params_from_gguf(reader, cfg))
         meta = dict(reader.metadata)
         reader.close()
         batcher = ContinuousBatcher(

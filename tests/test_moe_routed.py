@@ -1,0 +1,115 @@
+"""Routed (sparse) MoE dispatch vs the dense reference (VERDICT.md item 4).
+
+With a generous capacity factor no token drops, so routed output must equal
+dense-dispatch output (same math, different data movement) — off-mesh and
+expert-parallel over the 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import (
+    _moe_ffn,
+    forward,
+    init_params,
+    make_cache,
+)
+from nats_llm_studio_tpu.parallel.moe import _capacity, _route, routed_moe_ffn
+
+
+def _cfg(**kw):
+    base = dict(n_experts=8, n_experts_used=2, d_ff=32, n_layers=2,
+                moe_capacity_factor=8.0)
+    base.update(kw)
+    return ModelConfig.tiny(**base)
+
+
+def _layer_params(cfg, key):
+    """One layer's MoE params (strip the [L] stack axis)."""
+    p = init_params(cfg, key)["blocks"]
+    return {k: v[0] for k, v in p.items() if k in
+            ("router", "w_gate_e", "w_up_e", "w_down_e")}
+
+
+def test_routed_matches_dense_single_shard():
+    cfg = _cfg()
+    p = _layer_params(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, cfg.d_model), jnp.float32)
+    want = _moe_ffn(x, p, cfg)
+    got = routed_moe_ffn(x, p, cfg, mesh=None, capacity_factor=8.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_routed_matches_dense_on_ep_mesh():
+    from nats_llm_studio_tpu.parallel import build_mesh
+    from nats_llm_studio_tpu.parallel.sharding import shard_params
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 3, cfg.d_model), jnp.float32)
+    p = {k: v[0] for k, v in params["blocks"].items() if k in
+         ("router", "w_gate_e", "w_up_e", "w_down_e")}
+    want = _moe_ffn(x, p, cfg)
+
+    mesh = build_mesh({"ep": 8}, jax.devices()[:8])
+    sharded = shard_params(params, mesh)["blocks"]
+    p_sh = {k: jax.tree.map(lambda a: a[0], sharded[k]) for k in p}
+    got = jax.jit(
+        lambda x, p: routed_moe_ffn(x, p, cfg, mesh=mesh, capacity_factor=8.0)
+    )(x, p_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_routed_full_model_forward_matches_dense():
+    cfg_d = _cfg()
+    cfg_r = cfg_d.with_(use_routed_moe=True)
+    params = init_params(cfg_d, jax.random.PRNGKey(4))
+    toks = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    zero = jnp.zeros((1,), jnp.int32)
+    k, v = make_cache(cfg_d, 1, 16)
+    want, _, _ = forward(params, cfg_d, toks, k, v, zero)
+    k, v = make_cache(cfg_r, 1, 16)
+    got, _, _ = forward(params, cfg_r, toks, k, v, zero)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_routed_int8_runs():
+    from nats_llm_studio_tpu.ops.wquant import quantize_params
+
+    cfg = _cfg(use_routed_moe=True)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    q = jax.tree.map(jnp.asarray, quantize_params(jax.tree.map(np.asarray, params)))
+    k, v = make_cache(cfg, 1, 16)
+    logits, _, _ = forward(q, cfg, jnp.ones((1, 4), jnp.int32), k, v,
+                           jnp.zeros((1,), jnp.int32))
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_capacity_overflow_drops_not_crashes():
+    """With capacity factor << 1 every token competes for one slot per
+    expert; output must stay finite and shaped (dropped contributions are
+    zero, not NaN)."""
+    cfg = _cfg()
+    p = _layer_params(cfg, jax.random.PRNGKey(6))
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 8, cfg.d_model), jnp.float32)
+    got = routed_moe_ffn(x, p, cfg, mesh=None, capacity_factor=0.05)
+    assert got.shape == x.shape
+    assert bool(jnp.isfinite(got).all())
+
+
+def test_route_slot_assignment_unique_and_capped():
+    cfg = _cfg()
+    n, cap = 16, _capacity(16, cfg, 2.0)
+    x = jax.random.normal(jax.random.PRNGKey(8), (n, cfg.d_model), jnp.float32)
+    router = jax.random.normal(jax.random.PRNGKey(9), (cfg.d_model, cfg.n_experts),
+                               jnp.float32)
+    _, slot = _route(x, router, cfg, cap)
+    real = np.asarray(slot).ravel()
+    real = real[real < cfg.n_experts * cap]  # ignore trash slot
+    assert len(np.unique(real)) == len(real)  # scatter indices are unique
