@@ -7,7 +7,7 @@ the ring via ``ppermute`` (one ICI hop per step) while each device folds the
 incoming block into a running online-softmax state — compute and transfer
 overlap, memory stays O(T/n per chip). The reference has no analog (context
 length is whatever external llama.cpp supports — SURVEY.md §5 long-context);
-this is the TPU-native design the KV layout [L, B, S, H, D] was chosen for:
+this is the TPU-native design the KV layout [B, L, Hkv, S, D] was chosen for:
 adding the sp axis shards S without relayout.
 """
 

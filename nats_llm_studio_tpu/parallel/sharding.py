@@ -101,8 +101,8 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_spec(mesh: Mesh) -> P:
-    """KV cache [L, B, Hkv, S, D]: batch on dp, heads on tp."""
-    return P(None, _axis(mesh, AXIS_DP), _axis(mesh, AXIS_TP), None, None)
+    """KV cache [B, L, Hkv, S, D]: batch on dp, heads on tp."""
+    return P(_axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), None, None)
 
 
 def shard_cache(k_cache, v_cache, mesh: Mesh):

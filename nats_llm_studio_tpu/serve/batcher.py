@@ -7,7 +7,7 @@ requests reads them once per B tokens. Design:
 * one decode program compiled at a fixed ``[B, 1]`` batch width (no shape
   churn); empty slots run masked (token 0, pos 0, greedy) and are ignored
 * requests prefill into a single-row cache (bucketed lengths) and are
-  scattered into the shared ``[L, B, S, H, D]`` cache at their slot index —
+  scattered into the shared ``[B, L, Hkv, S, D]`` cache at their slot index —
   joining and leaving never recompiles the decode step
 * one dedicated owner thread drives the device (the decode loop is the one
   shared-mutable structure — SURVEY.md §5); asyncio callers talk to it
@@ -102,8 +102,8 @@ class ContinuousBatcher:
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert(K, V, k1, v1, slot):
             zero = jnp.zeros((), jnp.int32)
-            K = jax.lax.dynamic_update_slice(K, k1, (zero, slot, zero, zero, zero))
-            V = jax.lax.dynamic_update_slice(V, v1, (zero, slot, zero, zero, zero))
+            K = jax.lax.dynamic_update_slice(K, k1, (slot, zero, zero, zero, zero))
+            V = jax.lax.dynamic_update_slice(V, v1, (slot, zero, zero, zero, zero))
             return K, V
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(10,))

@@ -30,7 +30,7 @@ import numpy as np
 from __graft_entry__ import GRANITE_2B
 from nats_llm_studio_tpu.engine.sampling import sample_rows
 from nats_llm_studio_tpu.models.llama import ensure_lm_head, forward, init_params, make_cache
-from nats_llm_studio_tpu.ops.layers import gqa_attention, rms_norm, swiglu
+from nats_llm_studio_tpu.ops.layers import gqa_attention_hmajor, rms_norm, swiglu
 from nats_llm_studio_tpu.ops.wquant import mm, quantizable, quantize_weight
 
 STEPS = 64
@@ -119,6 +119,47 @@ def main() -> None:
     scan_bench("window", window_step, (jnp.ones((batch,), jnp.int32), K, V,
                                        jnp.full((batch,), 128, jnp.int32)), args=params)
 
+    # noattn: full forward structure — cache write + scan threading of the
+    # caches as xs/ys — but the attention read replaced by a q passthrough.
+    # (full - noattn) = attention read; (noattn - matmuls) = cache threading.
+    hq_, hkv_, d_ = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def noattn_step(params, c):
+        tok, K, V, pos = c
+        x = params["embed"][tok[:, None]].astype(jnp.dtype(cfg.dtype)) * cfg.embedding_scale
+        zero = jnp.zeros((), jnp.int32)
+
+        def block(carry, inputs):
+            x, K, V = carry
+            p, l = inputs
+            h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+            q = mm(h, p["wq"]).reshape(batch, 1, hq_, d_)
+            k = mm(h, p["wk"]).reshape(batch, 1, hkv_, d_)
+            v = mm(h, p["wv"]).reshape(batch, 1, hkv_, d_)
+
+            def write_row(cache_b, rows_b, s):  # cache_b [L,H,S,D]
+                return jax.lax.dynamic_update_slice(
+                    cache_b, rows_b[None].astype(cache_b.dtype), (l, zero, s, zero)
+                )
+
+            K = jax.vmap(write_row)(K, k.transpose(0, 2, 1, 3), pos)
+            V = jax.vmap(write_row)(V, v.transpose(0, 2, 1, 3), pos)
+            x = x + mm(q.reshape(batch, 1, hq_ * d_), p["wo"]) * cfg.residual_scale
+            h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)
+            x = x + swiglu(h, p["w_gate"], p["w_up"], p["w_down"]) * cfg.residual_scale
+            return (x, K, V), None
+
+        (x, K, V), _ = jax.lax.scan(
+            block, (x, K, V),
+            (params["blocks"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
+        )
+        logits = mm(rms_norm(x, params["out_norm"], cfg.rms_eps), params["lm_head"])
+        return (jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32), K, V, pos + 1)
+
+    K, V = make_cache(cfg, batch, seq)
+    scan_bench("noattn", noattn_step, (jnp.ones((batch,), jnp.int32), K, V,
+                                       jnp.full((batch,), 128, jnp.int32)), args=params)
+
     # matmuls only (same weights incl lm_head, no attention/cache/embed)
     x0 = jnp.ones((batch, 1, cfg.d_model), jnp.dtype(cfg.dtype))
 
@@ -140,29 +181,36 @@ def main() -> None:
 
     scan_bench("matmuls", matmul_step, x0, args=params)
 
-    # attention only: cache write + gqa read, per layer, scan over layers
+    # attention only: cache write + gqa read over the carried full cache
+    # (layout [B, L, Hkv, S, D], same carry structure as models.llama.forward)
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     def attn_step(_, c):
         acc, K, V, pos = c
-        q = jnp.ones((batch, 1, hq, d), K.dtype) * acc
-        k1 = jnp.ones((batch, 1, hkv, d), K.dtype)
+        q = jnp.ones((batch, 1, hq, d), K.dtype) * acc.astype(K.dtype)
+        k1 = jnp.ones((batch, hkv, 1, d), K.dtype)
         key_pos = jnp.arange(seq, dtype=jnp.int32)
         mask = key_pos[None, None, :] <= pos[:, None, None]
         zero = jnp.zeros((), jnp.int32)
 
-        def block(carry, layer):
-            kc, vc = layer
-            write = jax.vmap(
-                lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, zero, zero))
-            )
-            kc = write(kc, k1, pos)
-            vc = write(vc, k1, pos)
-            out = gqa_attention(q, kc.astype(q.dtype), vc.astype(q.dtype), mask,
-                                cfg.attn_scale)
-            return carry + jnp.sum(out, dtype=jnp.float32), (kc, vc)
+        def block(carry, l):
+            acc, K, V = carry
 
-        acc2, (K, V) = jax.lax.scan(block, jnp.zeros((), jnp.float32), (K, V))
+            def write_row(cache_b, rows_b, s):  # cache_b [L,H,S,D]
+                return jax.lax.dynamic_update_slice(cache_b, rows_b[None], (l, zero, s, zero))
+
+            K = jax.vmap(write_row)(K, k1, pos)
+            V = jax.vmap(write_row)(V, k1, pos)
+            kc = jax.lax.dynamic_slice(
+                K, (zero, l, zero, zero, zero), (batch, 1, hkv, seq, d))[:, 0]
+            vc = jax.lax.dynamic_slice(
+                V, (zero, l, zero, zero, zero), (batch, 1, hkv, seq, d))[:, 0]
+            out = gqa_attention_hmajor(q, kc, vc, mask, cfg.attn_scale)
+            return (acc + jnp.sum(out, dtype=jnp.float32), K, V), None
+
+        (acc2, K, V), _ = jax.lax.scan(
+            block, (jnp.zeros((), jnp.float32), K, V),
+            jnp.arange(cfg.n_layers, dtype=jnp.int32))
         return (acc2 * 1e-9, K, V, pos + 1)
 
     K, V = make_cache(cfg, batch, seq)

@@ -1,0 +1,86 @@
+"""Raw HBM bandwidth probes: how fast can this chip actually read the KV
+cache in various shapes/paths? Establishes the attention roofline."""
+
+import sys
+import time
+import functools
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timeit(name, fn, *args, n=20, nbytes=0):
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:32s}: {dt*1e3:8.3f} ms  {nbytes/dt/1e9:7.1f} GB/s", flush=True)
+
+
+L, B, H, S, D = 40, 8, 8, 1024, 64
+cache = jax.random.normal(jax.random.PRNGKey(0), (L, B, H, S, D), jnp.bfloat16)
+NB = cache.nbytes
+print(f"cache {NB/1e9:.3f} GB  [L,B,H,S,D]=[{L},{B},{H},{S},{D}] bf16", flush=True)
+
+# 1) XLA full reduce — upper bound for reads of this buffer
+timeit("xla sum (whole)", jax.jit(lambda c: jnp.sum(c, dtype=jnp.float32)), cache, nbytes=NB)
+
+# 2) XLA reduce reshaped to 2D
+c2 = cache.reshape(L * B * H * S, D)
+timeit("xla sum 2d", jax.jit(lambda c: jnp.sum(c, dtype=jnp.float32)), c2, nbytes=NB)
+
+# 3) XLA batched matvec (decode-score shape): [LBH, S, D] x [LBH, D, 8]
+c3 = cache.reshape(L * B * H, S, D)
+qv = jax.random.normal(jax.random.PRNGKey(1), (L * B * H, D, 8), jnp.bfloat16)
+timeit(
+    "xla batched matvec",
+    jax.jit(lambda c, q: jnp.einsum("nsd,ndg->nsg", c, q, preferred_element_type=jnp.float32).sum()),
+    c3, qv, nbytes=NB,
+)
+
+
+# 4) Pallas copy-reduce, block over S rows of one (l,b,h): grid (L*B*H,)
+def red_kernel(x_ref, o_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    s = jnp.sum(x_ref[...], dtype=jnp.float32)
+    o_ref[...] = o_ref[...] + jnp.broadcast_to(s[None, None], o_ref.shape)
+
+
+def pallas_reduce(c3, block_rows):
+    n = c3.shape[0]
+    return pl.pallas_call(
+        red_kernel,
+        grid=(n // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, c3.shape[1]), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.float32),
+    )(c3)
+
+
+flat = cache.reshape(L * B * H * S, D)
+for rows in (512, 2048, 8192):
+    f = jax.jit(functools.partial(pallas_reduce, block_rows=rows))
+    timeit(f"pallas reduce rows={rows}", f, flat, nbytes=NB)
+
+# 5) same but lanes=128 layout (D folded): [*, 128]
+flat128 = cache.reshape(L * B * H * S // 2, 128)
+for rows in (512, 4096):
+    f = jax.jit(functools.partial(pallas_reduce, block_rows=rows))
+    timeit(f"pallas reduce128 rows={rows}", f, flat128, nbytes=NB)
+
+# 6) grid-step overhead: tiny blocks, many steps
+f = jax.jit(functools.partial(pallas_reduce, block_rows=64))
+timeit("pallas reduce rows=64", f, flat[: 64 * 4096], nbytes=64 * 4096 * D * 2)

@@ -34,7 +34,7 @@ def test_forward_shapes(tiny):
     logits, k, v = forward(params, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32))
     assert logits.shape == (2, 4, cfg.vocab_size)
     assert logits.dtype == jnp.float32
-    assert k.shape == (cfg.n_layers, 2, cfg.n_kv_heads, 64, cfg.head_dim)
+    assert k.shape == (2, cfg.n_layers, cfg.n_kv_heads, 64, cfg.head_dim)
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
