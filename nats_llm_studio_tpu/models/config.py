@@ -41,6 +41,13 @@ class ModelConfig:
     # parallel/moe.py) vs dense reference (every expert computes every token)
     use_routed_moe: bool = False
     moe_capacity_factor: float = 2.0
+    # Unroll the decode-step layer loop (t == 1) instead of lax.scan: every
+    # layer/cache index becomes static, so XLA reads each cache slab as a
+    # view — no dynamic-slice materialization, no per-layer kernel-launch
+    # overhead (a pallas_call costs ~93 us on the serving chip; 40 layers of
+    # that is most of a decode step). Costs ~n_layers x compile time for the
+    # decode program only; prefill keeps the scan.
+    decode_unroll: bool = False
 
     @property
     def attn_scale(self) -> float:

@@ -19,11 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.flash_attention import (
-    decode_cache_supported,
-    flash_attention_auto,
-    flash_decode_cache_auto,
-)
+from ..ops.flash_attention import flash_attention_auto
 from ..ops.layers import (
     apply_rope,
     gqa_attention_hmajor,
@@ -54,6 +50,8 @@ def _attention_block(
     sin: jax.Array,
     mask: jax.Array,
     attn_window: int | None = None,
+    allow_flash: bool = True,
+    ring_slot: jax.Array | None = None,  # scalar: shared decode write slot
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -65,17 +63,64 @@ def _attention_block(
     k = apply_rope(k, cos, sin)
 
     zero = jnp.zeros((), start_pos.dtype)
-    # The caches ride the layer scan as CARRY (not xs/ys — scan ys do not
-    # alias xs, which would copy the whole cache every step: measured 8.6 ms
-    # of the 14 ms decode step on granite-2b/v5e). The fresh rows scatter
-    # into the full array at (b, layer, :, start_pos[b], :); inside the
-    # while-loop body the carry buffer's last use is this scatter, so XLA
-    # performs it in place — per-step cache write traffic is B*Hkv*T*D, not
-    # the whole cache. Batch is the LEADING cache axis: the batch-vmapped
-    # scatter makes XLA prefer a batch-outermost physical layout, and with
-    # B logical-major that preference coincides with the default layout the
-    # Pallas decode kernel requires — any other order inserts a full-cache
-    # relayout copy per layer (measured: 344 ms/step vs 5 ms).
+    win = attn_window if (attn_window is not None and attn_window < s_max) else s_max
+
+    def layer_slice(cache):
+        if isinstance(layer, int):  # unrolled decode: static slice = view
+            return cache[:, layer, :, :win]
+        sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
+                                   (b, 1, hkv, win, d))
+        return sl[:, 0]
+
+    if t == 1 and ring_slot is not None:
+        # Ring decode (the serving hot path): every row writes its fresh
+        # k/v at the SAME shared slot, so the cache update is ONE
+        # dynamic-update-slice spanning the batch — no per-row scatter
+        # (XLA lowers batched ragged scatters to a serialized while-loop,
+        # ~4.5 ms/step at batch 8) and no layout conflict (the in-loop DUS
+        # pins the cache to its default layout; without it XLA relayouts
+        # the whole cache per step for the attention dot, ~3 ms/step).
+        # Per-row validity is carried entirely by the ring mask built in
+        # forward(); attention reads the full cache at measured ~400 GB/s.
+        upd_k = k.transpose(0, 2, 1, 3)[:, None].astype(k_all.dtype)  # [B,1,Hkv,1,D]
+        upd_v = v.transpose(0, 2, 1, 3)[:, None].astype(v_all.dtype)
+        k_all = jax.lax.dynamic_update_slice(
+            k_all, upd_k, (zero, layer, zero, ring_slot, zero)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            v_all, upd_v, (zero, layer, zero, ring_slot, zero)
+        )
+
+        # valid slots may wrap around the ring, so attention always reads
+        # the full S axis (attn_window does not apply here)
+        def full_slice(cache):
+            sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
+                                       (b, 1, hkv, s_max, d))
+            return sl[:, 0]
+
+        out = gqa_attention_hmajor(
+            q,
+            full_slice(k_all).astype(q.dtype),
+            full_slice(v_all).astype(q.dtype),
+            mask,
+            cfg.attn_scale,
+        )
+        return mm(out.reshape(b, t, hq * d), p["wo"]), k_all, v_all
+
+    # Positional path (prefill, and decode without a shared ring slot):
+    # the caches ride the layer scan as CARRY (not xs/ys — scan ys do not
+    # alias xs, which would copy the whole cache every step). The fresh
+    # rows scatter into the full array at (b, layer, :, pos, :); the carry
+    # buffer's last use in the loop body is this scatter, so XLA performs
+    # it in place. Batch is the LEADING cache axis so the vmapped scatter's
+    # preferred batch-outermost physical layout IS the default layout — any
+    # other order inserts a full-cache relayout copy per layer (measured:
+    # 344 ms/step vs 5 ms). The ragged scatter itself lowers to a
+    # serialized row loop (~4.5 ms/step at batch 8 — the reason serving
+    # uses the ring path), but it also pins the cache layout, which keeps
+    # the attention dot reading the cache IN PLACE at ~400 GB/s; every
+    # structure that removed the scatter made XLA materialize+relayout the
+    # slab per layer and lost more than the scatter costs.
     def write_row(cache_b, rows_b, s):  # cache_b [L,Hkv,S,D]; rows_b [Hkv,T,D]
         return jax.lax.dynamic_update_slice(
             cache_b, rows_b[None].astype(cache_b.dtype), (layer, zero, s, zero)
@@ -85,15 +130,7 @@ def _attention_block(
     k_all = write(k_all, k.transpose(0, 2, 1, 3), start_pos)
     v_all = write(v_all, v.transpose(0, 2, 1, 3), start_pos)
 
-    # Attention reads this layer's slice of the live prefix only.
-    win = attn_window if (attn_window is not None and attn_window < s_max) else s_max
-
-    def layer_slice(cache):
-        sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
-                                   (b, 1, hkv, win, d))
-        return sl[:, 0]
-
-    if cfg.use_flash_attention and t > 1:
+    if cfg.use_flash_attention and t > 1 and allow_flash:
         # prefill at start_pos 0: the cache holds exactly k/v, so causal
         # attention over the fresh block equals attention over the cache.
         # At start_pos > 0 (chunked prefill) the fresh block misses earlier
@@ -110,15 +147,6 @@ def _attention_block(
             )
 
         out = jax.lax.cond(jnp.all(start_pos == 0), _flash, _dense, (q, k, v))
-    elif cfg.use_flash_attention and t == 1 and decode_cache_supported(s_max):
-        # decode: the cache row at start_pos now holds the fresh k/v, so the
-        # token attends to cache[:start_pos+1]. The kernel indexes the full
-        # [L, ...] cache at (layer, b, h, tile) via scalar prefetch — no layer
-        # slice is ever materialized and tiles beyond each row's live prefix
-        # are never fetched.
-        out = flash_decode_cache_auto(
-            q[:, 0], k_all, v_all, layer, start_pos, cfg.attn_scale
-        )[:, None]
     else:
         out = gqa_attention_hmajor(
             q,
@@ -156,6 +184,7 @@ def forward(
     start_pos: jax.Array,  # int32 [B] — write offset per row (0 for prefill)
     attn_window: int | None = None,  # static: attend to cache[:window] only
     mesh=None,  # static: enables the expert-parallel routed-MoE shard_map
+    ring_slot: jax.Array | None = None,  # int32 scalar: shared decode write slot
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache).
 
@@ -165,25 +194,38 @@ def forward(
     overwrites them in order. ``attn_window`` (a compile-time bucket >= every
     live sequence length) bounds attention reads to the active cache prefix.
 
-    The caches thread the layer scan as carry (full [L, ...] arrays with
-    per-layer scatter at a traced layer index) — see _attention_block for why
-    this, and not scan xs/ys, is the layout that decodes at HBM speed.
+    Decode modes (T = 1):
+    * ``ring_slot`` given (the serving hot path): the cache S axis is a RING
+      indexed by a global step counter shared across rows, not by per-row
+      position. Every row's fresh k/v land at slot ``ring_slot``; a row with
+      current length p attends to the p+1 ring slots ending at ``ring_slot``
+      (its tokens are contiguous there because the batcher aligns each
+      admitted prefix to end at the ring head, and every row writes every
+      step). One shared slot = one batched cache write per layer — the shape
+      XLA compiles to an in-place update at full HBM speed.
+    * ``ring_slot`` None (tests, ragged callers): slots equal per-row
+      positions, written by a per-layer batched scatter.
     """
     b, t = tokens.shape
     s_max = k_cache.shape[3]
     positions = start_pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     key_pos = jnp.arange(s_max, dtype=jnp.int32)
-    mask = key_pos[None, None, :] <= positions[:, :, None]  # [B,T,S]
+    if t == 1 and ring_slot is not None:
+        # ring validity: slot j holds row b's token iff it is one of the
+        # start_pos+1 most recent ring slots (ending at ring_slot, wrapped)
+        age = jnp.mod(ring_slot - key_pos, s_max)  # [S]
+        mask = age[None, None, :] <= start_pos[:, None, None]  # [B,1,S]
+    else:
+        mask = key_pos[None, None, :] <= positions[:, :, None]  # [B,T,S]
 
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype)) * cfg.embedding_scale
 
-    def block(carry, inputs):
-        x, k_all, v_all = carry
-        p, layer = inputs
+    def block_body(x, k_all, v_all, p, layer, allow_flash=True):
         attn_out, k_all, v_all = _attention_block(
             rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, k_all, v_all, layer,
-            start_pos, cos, sin, mask, attn_window,
+            start_pos, cos, sin, mask, attn_window, allow_flash,
+            ring_slot if t == 1 else None,
         )
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)
@@ -197,12 +239,27 @@ def forward(
         else:
             ffn_out = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
         x = x + ffn_out * cfg.residual_scale
-        return (x, k_all, v_all), None
+        return x, k_all, v_all
 
-    layer_idx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, k_cache, v_cache), _ = jax.lax.scan(
-        block, (x, k_cache, v_cache), (params["blocks"], layer_idx)
-    )
+    if cfg.decode_unroll and t == 1:
+        # Unrolled decode: static layer indices make every cache access a
+        # zero-copy view, at ~n_layers x the compile time.
+        for l in range(cfg.n_layers):
+            p = jax.tree.map(lambda a: a[l], params["blocks"])
+            x, k_cache, v_cache = block_body(
+                x, k_cache, v_cache, p, l, allow_flash=False
+            )
+    else:
+        def block(carry, inputs):
+            x, k_all, v_all = carry
+            p, layer = inputs
+            return block_body(x, k_all, v_all, p, layer), None
+
+        layer_idx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+        (x, k_cache, v_cache), _ = jax.lax.scan(
+            block, (x, k_cache, v_cache), (params["blocks"], layer_idx)
+        )
+
     x = rms_norm(x, params["out_norm"], cfg.rms_eps)
     lm_head = params.get("lm_head")
     if lm_head is None:
@@ -229,10 +286,11 @@ def make_cache(
 ) -> tuple[jax.Array, jax.Array]:
     """Zeroed KV cache pair, layout [B, L, Hkv, S, D] — batch-major so the
     per-row scatter's preferred physical layout IS the default layout (see
-    _attention_block), heads-major within a row so each (batch, head) slab is
-    contiguous: decode attention DMA-streams the cache sequentially
-    (ops.flash_attention.flash_decode_cache), the TP axis annotates Hkv, and
-    a later sequence/ring axis annotates S without relayout (SURVEY.md §5)."""
+    _attention_block), heads-major within a row so each (batch, head) slab
+    is contiguous and the decode attention dot streams it sequentially; the
+    TP axis annotates Hkv and a sequence/ring axis annotates S without
+    relayout (SURVEY.md §5). In ring-decode serving the S axis is a ring
+    indexed by a shared step counter, not per-row position (see forward)."""
     s = seq_len or cfg.max_seq_len
     shape = (batch, cfg.n_layers, cfg.n_kv_heads, s, cfg.head_dim)
     dt = jnp.dtype(dtype or cfg.dtype)

@@ -123,236 +123,3 @@ def flash_attention_auto(q, k, v, scale: float) -> jax.Array:
     backend run the same kernel logic through the Pallas interpreter)."""
     interpret = jax.default_backend() != "tpu"
     return flash_attention(q, k, v, scale, interpret=interpret)
-
-
-# ---------------------------------------------------------------------------
-# decode (single-token) attention over the KV cache
-# ---------------------------------------------------------------------------
-
-
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
-    """One (batch, kv-head) cell: the G grouped q-heads attend over the
-    cache prefix [0, pos]. Online softmax over key tiles; everything f32 in
-    VMEM."""
-    pos = pos_ref[pl.program_id(0)]  # [B] vector in SMEM
-    g, d = q_ref.shape[2], q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
-    n_kv = k_ref.shape[2]
-
-    def body(kt, carry):
-        acc, m, l = carry
-        k = k_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [G, BK]
-        k_pos = kt * block_k + jax.lax.broadcasted_iota(jnp.int32, (g, block_k), 1)
-        s = jnp.where(k_pos <= pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc_new, m_new, l_new
-
-    # only tiles covering [0, pos] — dynamic trip count skips dead compute
-    n_tiles = jnp.minimum(pos // block_k + 1, n_kv // block_k)
-    acc0 = jnp.zeros((g, d), jnp.float32)
-    m0 = jnp.full((g,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((g,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_tiles, body, (acc0, m0, l0))
-    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("scale", "block_k", "interpret"))
-def flash_decode(
-    q: jax.Array,  # [B, Hq, D] — the single new token's queries
-    k_cache: jax.Array,  # [B, Hkv, S, D] (heads-major cache layout)
-    v_cache: jax.Array,
-    pos: jax.Array,  # int32 [B] — attend to cache[:pos+1]
-    scale: float,
-    block_k: int = 512,
-    interpret: bool = False,
-) -> jax.Array:
-    """Decode attention: reads each (batch, kv head) cache slab exactly once
-    via sequential DMA — replaces the XLA einsum path whose tiny per-head
-    matmuls left cache reads ~6x below HBM speed. Returns [B, Hq, D]."""
-    b, hq, d = q.shape
-    hkv, s_max = k_cache.shape[1], k_cache.shape[2]
-    g = hq // hkv
-    block_k = min(block_k, s_max)
-    # group q rows by kv head; pad the group dim to the f32 sublane tile
-    gp = max(8, g)
-    q4 = q.reshape(b, hkv, g, d)
-    if gp != g:
-        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
-
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, block_k=block_k),
-        grid=(b, hkv),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # pos [B]
-            pl.BlockSpec((1, 1, gp, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s_max, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s_max, d), lambda bi, hi: (bi, hi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, gp, d), lambda bi, hi: (bi, hi, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
-        interpret=interpret,
-    )(pos.astype(jnp.int32), q4, k_cache, v_cache)
-    return out[:, :, :g, :].reshape(b, hq, d)
-
-
-def flash_decode_auto(q, k_cache, v_cache, pos, scale: float) -> jax.Array:
-    interpret = jax.default_backend() != "tpu"
-    return flash_decode(q, k_cache, v_cache, pos, scale, interpret=interpret)
-
-
-# ---------------------------------------------------------------------------
-# decode attention over the FULL layer-stacked cache (the serving hot path)
-# ---------------------------------------------------------------------------
-
-
-def _pick_block_k(s_max: int) -> int | None:
-    # 256 keys x 8 kv heads x 64 dims x bf16 = 256 KB per cache per grid
-    # step: big enough to amortize the ~0.5 us step overhead, small enough
-    # for fine dead-tile skipping and the 16 MB scoped-VMEM budget
-    for bk in (256, 512, 128):
-        if s_max % bk == 0:
-            return bk
-    return s_max if s_max <= 512 and s_max % 16 == 0 else None
-
-
-def _decode_cache_kernel(
-    l_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, s_ref,
-    *, scale: float, block_k: int, gp: int
-):
-    """One grid step = one (batch, key-tile) covering ALL kv heads — the
-    per-step DMA is Hkv*block_k*D*2 bytes per cache, large enough that the
-    ~0.5 us grid-step overhead is amortized. Scores are one 2D dot with the
-    heads folded into rows/cols; a block-diagonal head mask (fused with the
-    position mask) zeroes cross-head terms, so the combine dot can sum over
-    every column. Online-softmax state persists in VMEM scratch across the
-    key-tile axis; dead tiles (beyond the row's live prefix) skip compute
-    (pl.when) and DMA (their index_map revisits the previous tile, which the
-    Pallas pipeline elides)."""
-    bi, kt = pl.program_id(0), pl.program_id(1)
-    pos = pos_ref[bi]
-    h, d = q_ref.shape[1], q_ref.shape[3]
-    rows, cols = h * gp, h * block_k
-
-    @pl.when(kt == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        s_ref[...] = jnp.zeros_like(s_ref)
-
-    @pl.when(kt * block_k <= pos)
-    def _compute():
-        q = q_ref[0].reshape(rows, d).astype(jnp.float32) * scale
-        k = k_ref[0, 0].reshape(cols, d).astype(jnp.float32)
-        v = v_ref[0, 0].reshape(cols, d).astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [rows, cols]
-        row_h = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) // gp
-        col_i = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
-        col_h = col_i // block_k
-        k_pos = kt * block_k + (col_i - col_h * block_k)
-        s = jnp.where((row_h == col_h) & (k_pos <= pos), s, _NEG_INF)
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m_prev - m_new)
-        s_new = s_ref[:, 0] * corr + jnp.sum(p, axis=1)
-        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
-        s_ref[...] = jnp.broadcast_to(s_new[:, None], s_ref.shape)
-
-    @pl.when(kt == pl.num_programs(1) - 1)
-    def _finish():
-        out = acc_ref[...] / jnp.maximum(s_ref[:, :1], 1e-30)
-        o_ref[0] = out.reshape(h, gp, d).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
-def flash_decode_cache(
-    q: jax.Array,  # [B, Hq, D] — the new token's queries
-    k_all: jax.Array,  # [B, L, Hkv, S, D] — the FULL layer-stacked cache
-    v_all: jax.Array,
-    layer: jax.Array,  # int32 scalar — which layer's slab to read
-    pos: jax.Array,  # int32 [B] — attend to cache[:pos+1] per row
-    scale: float,
-    interpret: bool = False,
-) -> jax.Array:
-    """Decode attention reading the cache in place.
-
-    The layer scan carries the full cache; slicing out layer ``l`` under XLA
-    materializes a copy (read+write of the whole slab) before attention even
-    starts. Here the kernel indexes [b, l, tile] directly via
-    scalar-prefetched index maps, so per-step HBM traffic is exactly the live
-    prefix of each row — no copies, no dead-tile reads. Returns [B, Hq, D].
-    """
-    b, hq, d = q.shape
-    hkv, s_max = k_all.shape[2], k_all.shape[3]
-    g = hq // hkv
-    block_k = _pick_block_k(s_max)
-    assert block_k is not None, f"s_max={s_max} unsupported; caller must fall back"
-    gp = max(8, g)
-    q4 = q.reshape(b, hkv, g, d).astype(jnp.float32)
-    if gp != g:
-        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
-
-    def q_map(bi, kt, l_ref, pos_ref):
-        return (bi, 0, 0, 0)
-
-    def kv_map(bi, kt, l_ref, pos_ref):
-        live = pos_ref[bi] // block_k
-        return (bi, l_ref[0], 0, jnp.minimum(kt, live), 0)
-
-    def o_map(bi, kt, l_ref, pos_ref):
-        return (bi, 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, s_max // block_k),
-        in_specs=[
-            pl.BlockSpec((1, hkv, gp, d), q_map),
-            pl.BlockSpec((1, 1, hkv, block_k, d), kv_map),
-            pl.BlockSpec((1, 1, hkv, block_k, d), kv_map),
-        ],
-        out_specs=pl.BlockSpec((1, hkv, gp, d), o_map),
-        scratch_shapes=[
-            pltpu.VMEM((hkv * gp, d), jnp.float32),
-            pltpu.VMEM((hkv * gp, 128), jnp.float32),
-            pltpu.VMEM((hkv * gp, 128), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        functools.partial(
-            _decode_cache_kernel, scale=scale, block_k=block_k, gp=gp
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), q.dtype),
-        interpret=interpret,
-    )(
-        jnp.reshape(layer, (1,)).astype(jnp.int32),
-        pos.astype(jnp.int32),
-        q4,
-        k_all,
-        v_all,
-    )
-    return out[:, :, :g, :].reshape(b, hq, d)
-
-
-def flash_decode_cache_auto(q, k_all, v_all, layer, pos, scale: float) -> jax.Array:
-    interpret = jax.default_backend() != "tpu"
-    return flash_decode_cache(q, k_all, v_all, layer, pos, scale, interpret=interpret)
-
-
-def decode_cache_supported(s_max: int) -> bool:
-    return _pick_block_k(s_max) is not None

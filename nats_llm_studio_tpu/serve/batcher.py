@@ -100,17 +100,27 @@ class ContinuousBatcher:
             return logits, k1, v1
 
         @partial(jax.jit, donate_argnums=(0, 1))
-        def insert(K, V, k1, v1, slot):
+        def insert(K, V, k1, v1, slot, shift):
+            """Scatter a prefilled single-row cache into the shared ring.
+
+            The prefix (tokens at [0, n) of k1) must land on the ring slots
+            ending at the current ring head, so the whole row is rolled by
+            ``shift`` = (ring_next - n) mod S before the row write — decode
+            validity is "the start_pos+1 most recent ring slots" and relies
+            on every row's tokens being slot-contiguous there.
+            """
             zero = jnp.zeros((), jnp.int32)
+            k1 = jnp.roll(k1, shift, axis=3)
+            v1 = jnp.roll(v1, shift, axis=3)
             K = jax.lax.dynamic_update_slice(K, k1, (slot, zero, zero, zero, zero))
             V = jax.lax.dynamic_update_slice(V, v1, (slot, zero, zero, zero, zero))
             return K, V
 
-        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(10,))
-        def decode(params, tok, K, V, pos, seeds, steps, temp, topk, topp, window):
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp):
             logits, K, V = fwd(
                 params, tokens=tok[:, None], k_cache=K, v_cache=V, start_pos=pos,
-                attn_window=window,
+                ring_slot=ring,
             )
             nxt = sample_rows(logits[:, -1, :], seeds, steps, temp, topk, topp)
             return nxt, K, V
@@ -198,6 +208,9 @@ class ContinuousBatcher:
     def _run(self) -> None:
         cfg = self.cfg
         B = self.max_slots
+        # ring head: the shared cache slot the next decode step writes; rows'
+        # validity is "my last pos+1 ring slots", see models.llama.forward
+        self._ring_next = 0
         K, V = make_cache(cfg, B, self.max_seq)
         if self.mesh is not None:
             from ..parallel.sharding import shard_cache
@@ -226,7 +239,8 @@ class ContinuousBatcher:
             k1, v1 = make_cache(cfg, 1, self.max_seq)
             tokens = jnp.asarray([req.prompt_ids + [0] * (bucket - n)], jnp.int32)
             logits, k1, v1 = self._prefill1(self.params, tokens, k1, v1)
-            K, V = self._insert(K, V, k1, v1, jnp.int32(slot))
+            shift = (self._ring_next - n) % self.max_seq
+            K, V = self._insert(K, V, k1, v1, jnp.int32(slot), jnp.int32(shift))
             sp = req.sp
             seed = sp.seed if sp.seed is not None else random.getrandbits(31)
             first = sample_rows(
@@ -289,15 +303,11 @@ class ContinuousBatcher:
             steps = jnp.asarray(
                 [r.generated if r else 0 for r in self._slots], jnp.int32
             )
-            # attention reads only the bucket covering the longest live row —
-            # but XLA materializes the sliced cache, so the slice only pays
-            # when the window is well under the full cache length
-            window = self._bucket(max(host_pos[i] for i in act) + 1)
-            if window * 3 > self.max_seq:
-                window = None
             nxt, K, V = self._decode(
-                self.params, tok, K, V, pos, seeds, steps, temp, topk, topp, window
+                self.params, tok, K, V, pos, jnp.int32(self._ring_next),
+                seeds, steps, temp, topk, topp,
             )
+            self._ring_next = (self._ring_next + 1) % self.max_seq
             ids = [int(x) for x in nxt]  # one host transfer per step
             self.stats.steps += 1
             for i in act:

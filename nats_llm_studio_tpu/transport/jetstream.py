@@ -205,23 +205,36 @@ class ObjectStore:
             raise ObjectNotFound(f"object {name!r} is deleted")
         return inf
 
-    async def get(self, bucket: str, name: str) -> bytes:
+    async def get_chunks(self, bucket: str, name: str):
+        """Stream an object chunk by chunk (async generator).
+
+        O(chunk) memory regardless of object size — the path multi-GB model
+        pulls ride (the 100 GiB file-store contract, setup_unix.sh analog).
+        Size and SHA-256 digest are verified incrementally; a mismatch
+        raises after the last chunk, before the caller commits the result.
+        """
         inf = await self.info(bucket, name)
         chunk_subject = f"$O.{bucket}.C.{inf.nuid}"
-        parts: list[bytes] = []
         seq = 0
+        total = 0
+        h = hashlib.sha256()
         for _ in range(inf.chunks):
             msg = await self._direct_get(
                 self._stream(bucket), {"seq": seq + 1, "next_by_subj": chunk_subject}
             )
-            parts.append(msg.payload)
             seq = int((msg.headers or {}).get("Nats-Sequence", seq + 1))
-        data = b"".join(parts)
-        if len(data) != inf.size:
-            raise ObjectStoreError(f"size mismatch for {name!r}: {len(data)} != {inf.size}")
-        if inf.digest and _digest(data) != inf.digest:
+            total += len(msg.payload)
+            h.update(msg.payload)
+            yield msg.payload
+        if total != inf.size:
+            raise ObjectStoreError(f"size mismatch for {name!r}: {total} != {inf.size}")
+        want = "SHA-256=" + base64.urlsafe_b64encode(h.digest()).decode()
+        if inf.digest and want != inf.digest:
             raise ObjectStoreError(f"digest mismatch for {name!r}")
-        return data
+
+    async def get(self, bucket: str, name: str) -> bytes:
+        parts = [chunk async for chunk in self.get_chunks(bucket, name)]
+        return b"".join(parts)
 
     async def delete(self, bucket: str, name: str) -> None:
         inf = await self.info(bucket, name)

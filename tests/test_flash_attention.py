@@ -49,63 +49,6 @@ def test_flash_scale_applied():
     assert not np.allclose(np.asarray(a), np.asarray(b))
 
 
-def test_flash_decode_matches_reference():
-    """Decode kernel vs dense gqa over the cache prefix, ragged positions."""
-    from nats_llm_studio_tpu.ops.flash_attention import flash_decode
-
-    b, s, hq, hkv, d = 3, 64, 8, 2, 16
-    kq, kk, kv = jax.random.split(RNG, 3)
-    q = jax.random.normal(kq, (b, hq, d), jnp.float32)
-    kc = jax.random.normal(kk, (b, hkv, s, d), jnp.float32)  # heads-major cache
-    vc = jax.random.normal(kv, (b, hkv, s, d), jnp.float32)
-    pos = jnp.asarray([0, 17, 63], jnp.int32)
-    scale = d**-0.5
-    key_pos = jnp.arange(s)
-    mask = key_pos[None, None, :] <= pos[:, None, None]  # [B,1,S]
-    want = gqa_attention_hmajor(q[:, None], kc, vc, mask, scale)[:, 0]
-    got = flash_decode(q, kc, vc, pos, scale, block_k=16, interpret=True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
-
-
-def test_flash_decode_cache_matches_reference():
-    """Layer-stacked decode kernel: reads the full [B,L,H,S,D] cache at a
-    traced layer index, ragged per-row positions, GQA grouping."""
-    from nats_llm_studio_tpu.ops.flash_attention import flash_decode_cache
-
-    L, b, s, hq, hkv, d = 3, 3, 64, 8, 2, 16
-    kq, kk, kv = jax.random.split(RNG, 3)
-    q = jax.random.normal(kq, (b, hq, d), jnp.float32)
-    kc = jax.random.normal(kk, (b, L, hkv, s, d), jnp.float32)
-    vc = jax.random.normal(kv, (b, L, hkv, s, d), jnp.float32)
-    pos = jnp.asarray([0, 17, 63], jnp.int32)
-    scale = d**-0.5
-    key_pos = jnp.arange(s)
-    mask = key_pos[None, None, :] <= pos[:, None, None]  # [B,1,S]
-    for layer in (0, 1, 2):
-        want = gqa_attention_hmajor(q[:, None], kc[:, layer], vc[:, layer], mask, scale)[:, 0]
-        got = flash_decode_cache(
-            q, kc, vc, jnp.int32(layer), pos, scale, interpret=True
-        )
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
-
-
-def test_model_decode_with_flash_matches_dense():
-    """Full-model decode step (t=1, start_pos>0) through the flash-decode
-    kernel must match the XLA mask path."""
-    cfg = ModelConfig.tiny(n_layers=2)
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    toks = jnp.asarray([[5, 6, 7, 8, 9]], jnp.int32)
-    zero = jnp.zeros((1,), jnp.int32)
-    k, v = make_cache(cfg, 1, 32)
-    _, k, v = forward(params, cfg, toks, k, v, zero)
-    pos = jnp.full((1,), 5, jnp.int32)
-    nxt = jnp.asarray([[11]], jnp.int32)
-    want, _, _ = forward(params, cfg, nxt, k, v, pos)
-    cfg_f = cfg.with_(use_flash_attention=True)
-    got, _, _ = forward(params, cfg_f, nxt, k, v, pos)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3)
-
-
 def test_model_forward_with_flash_matches_dense():
     """Full-model prefill with the flash path must match the XLA mask path."""
     cfg = ModelConfig.tiny(n_layers=2)
