@@ -52,6 +52,7 @@ def _attention_block(
     attn_window: int | None = None,
     allow_flash: bool = True,
     ring_slot: jax.Array | None = None,  # scalar: shared decode write slot
+    mesh=None,  # enables the sp ring-attention prefill when the mesh has sp>1
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -130,14 +131,27 @@ def _attention_block(
     k_all = write(k_all, k.transpose(0, 2, 1, 3), start_pos)
     v_all = write(v_all, v.transpose(0, 2, 1, 3), start_pos)
 
-    if cfg.use_flash_attention and t > 1 and allow_flash:
+    sp_ring = False
+    if mesh is not None and t > 1:
+        from ..parallel.mesh import AXIS_SP
+
+        sp_ring = AXIS_SP in mesh.axis_names and mesh.shape[AXIS_SP] > 1
+
+    if t > 1 and (sp_ring or (cfg.use_flash_attention and allow_flash)):
         # prefill at start_pos 0: the cache holds exactly k/v, so causal
         # attention over the fresh block equals attention over the cache.
         # At start_pos > 0 (chunked prefill) the fresh block misses earlier
         # cache entries, so fall back to full-cache attention — lax.cond
         # executes only the taken branch per step.
-        def _flash(ops):
+        def _fresh_block(ops):
             q, k, v = ops
+            if sp_ring:
+                # sequence-parallel prefill: T sharded on sp, K/V blocks
+                # rotate the ring via ppermute (parallel/ring_attention) —
+                # the long-context path where one chip cannot hold [T, T]
+                from ..parallel.ring_attention import ring_attention
+
+                return ring_attention(q, k, v, cfg.attn_scale, mesh)
             return flash_attention_auto(q, k, v, cfg.attn_scale)
 
         def _dense(ops):
@@ -146,7 +160,7 @@ def _attention_block(
                 q, k.astype(q.dtype), v.astype(q.dtype), mask[:, :, :win], cfg.attn_scale
             )
 
-        out = jax.lax.cond(jnp.all(start_pos == 0), _flash, _dense, (q, k, v))
+        out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _dense, (q, k, v))
     else:
         out = gqa_attention_hmajor(
             q,
@@ -225,7 +239,7 @@ def forward(
         attn_out, k_all, v_all = _attention_block(
             rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, k_all, v_all, layer,
             start_pos, cos, sin, mask, attn_window, allow_flash,
-            ring_slot if t == 1 else None,
+            ring_slot if t == 1 else None, mesh,
         )
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)

@@ -4,8 +4,8 @@ Megatron-style TP (BASELINE.md config 3: Llama-3-70B TP=8 on v5e-8): QKV and
 FFN-in sharded on their output-features axis, attn-out and FFN-down on their
 input axis — so each block does local matmuls and GSPMD inserts exactly one
 all-reduce after attention and one after the MLP. Experts shard on the ep
-axis (config 4: Mixtral). The KV cache shards heads on tp and batch on dp; S
-stays unsharded so a future sp/ring axis is additive (SURVEY.md §5).
+axis (config 4: Mixtral). The KV cache shards heads on tp, batch on dp, and
+the sequence axis on sp (ring attention; SURVEY.md §5).
 
 Weights keep a leading [L] stack axis (lax.scan), so every rule below starts
 with None for L.
@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..ops.wquant import QTensor
-from .mesh import AXIS_DP, AXIS_EP, AXIS_TP
+from .mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP
 
 
 def _axis(mesh: Mesh, name: str) -> str | None:
@@ -101,8 +101,12 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_spec(mesh: Mesh) -> P:
-    """KV cache [B, L, Hkv, S, D]: batch on dp, heads on tp."""
-    return P(_axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), None, None)
+    """KV cache [B, L, Hkv, S, D]: batch on dp, heads on tp, sequence on sp
+    (the ring-attention axis — long prompts' cache memory scales down with
+    the sp degree; SURVEY.md §5 long-context)."""
+    return P(
+        _axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), _axis(mesh, AXIS_SP), None
+    )
 
 
 def shard_cache(k_cache, v_cache, mesh: Mesh):
@@ -127,3 +131,6 @@ def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig) -> None:
         raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
     if cfg.is_moe and ep > 1 and cfg.n_experts % ep:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")
+    sp = mesh.shape.get(AXIS_SP, 1)
+    if sp > 1 and cfg.max_seq_len % sp:
+        raise ValueError(f"max_seq_len={cfg.max_seq_len} not divisible by sp={sp}")

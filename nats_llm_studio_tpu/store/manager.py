@@ -182,7 +182,12 @@ class ModelStore:
             with open(tmp, "wb") as f:
                 async for chunk in store.get_chunks(self.bucket, obj_name):
                     total += len(chunk)
-                    await asyncio.to_thread(f.write, chunk)  # keep the loop serving
+                    # buffered ~128 KB writes are ~us-cheap; a to_thread hop
+                    # per chunk would cost more than the write itself. Yield
+                    # periodically so a multi-GB pull cannot starve the loop.
+                    f.write(chunk)
+                    if total % (64 << 20) < len(chunk):
+                        await asyncio.sleep(0)
         except ObjectNotFound as e:
             tmp.unlink(missing_ok=True)
             raise StoreError(f"object {obj_name!r} not found: {e}") from None

@@ -1,0 +1,235 @@
+"""Cross-implementation fixtures (VERDICT round-1 item 7).
+
+The GGUF/quant/tokenizer tests elsewhere round-trip through the in-tree
+writer, so a shared layout misunderstanding would pass. Here the expected
+values come from INDEPENDENT implementations written directly from the
+public ggml format definitions (scalar, loop-by-loop, mirroring
+llama.cpp's dequantize_row_* structure) and from hand-computed tokenizer
+examples — none of it touches the in-tree vectorized decoders or encoder.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.gguf.constants import GGMLType
+from nats_llm_studio_tpu.gguf.quants import dequantize
+
+RNG = np.random.default_rng(1234)
+
+
+def _rand_f16(n: int) -> np.ndarray:
+    """Random finite, well-scaled f16 values (as raw u16 view)."""
+    vals = RNG.uniform(-2.0, 2.0, n).astype(np.float16)
+    return vals.view(np.uint16)
+
+
+# ---------------------------------------------------------------------------
+# scalar reference dequantizers (from the public ggml block layouts)
+# ---------------------------------------------------------------------------
+
+
+def scalar_q8_0(block: bytes) -> list[float]:
+    d = np.frombuffer(block[:2], np.float16)[0].astype(np.float32)
+    qs = np.frombuffer(block[2:34], np.int8)
+    return [float(d) * int(q) for q in qs]
+
+
+def scalar_q4_0(block: bytes) -> list[float]:
+    d = np.frombuffer(block[:2], np.float16)[0].astype(np.float32)
+    qs = block[2:18]
+    out = [0.0] * 32
+    for i in range(16):
+        out[i] = float(d) * ((qs[i] & 0x0F) - 8)
+        out[i + 16] = float(d) * ((qs[i] >> 4) - 8)
+    return out
+
+
+def _q4k_scale_min(scales: bytes, j: int) -> tuple[int, int]:
+    """6-bit (scale, min) pair j of the 12-byte Q4_K scales field."""
+    if j < 4:
+        sc = scales[j] & 63
+        m = scales[j + 4] & 63
+    else:
+        sc = (scales[j + 4] & 0x0F) | ((scales[j - 4] >> 6) << 4)
+        m = (scales[j + 4] >> 4) | ((scales[j] >> 6) << 4)
+    return sc, m
+
+
+def scalar_q4_k(block: bytes) -> list[float]:
+    """256-element Q4_K super-block: d f16, dmin f16, scales[12], qs[128]."""
+    d = float(np.frombuffer(block[:2], np.float16)[0])
+    dmin = float(np.frombuffer(block[2:4], np.float16)[0])
+    scales = block[4:16]
+    qs = block[16:144]
+    out = [0.0] * 256
+    for chunk in range(4):  # 64 elements per chunk: 32 low then 32 high nibbles
+        ql = qs[32 * chunk : 32 * chunk + 32]
+        sc1, m1 = _q4k_scale_min(scales, 2 * chunk)
+        sc2, m2 = _q4k_scale_min(scales, 2 * chunk + 1)
+        for i in range(32):
+            out[64 * chunk + i] = d * sc1 * (ql[i] & 0x0F) - dmin * m1
+            out[64 * chunk + 32 + i] = d * sc2 * (ql[i] >> 4) - dmin * m2
+    return out
+
+
+def scalar_q6_k(block: bytes) -> list[float]:
+    """256-element Q6_K super-block: ql[128], qh[64], scales[16] i8, d f16."""
+    ql = block[0:128]
+    qh = block[128:192]
+    scales = np.frombuffer(block[192:208], np.int8)
+    d = float(np.frombuffer(block[208:210], np.float16)[0])
+    out = [0.0] * 256
+    for n in range(2):  # two 128-element halves
+        for l in range(32):
+            is_ = l // 16
+            q1 = ((ql[n * 64 + l] & 0x0F) | (((qh[n * 32 + l] >> 0) & 3) << 4)) - 32
+            q2 = ((ql[n * 64 + l + 32] & 0x0F) | (((qh[n * 32 + l] >> 2) & 3) << 4)) - 32
+            q3 = ((ql[n * 64 + l] >> 4) | (((qh[n * 32 + l] >> 4) & 3) << 4)) - 32
+            q4 = ((ql[n * 64 + l + 32] >> 4) | (((qh[n * 32 + l] >> 6) & 3) << 4)) - 32
+            out[n * 128 + l + 0] = d * int(scales[n * 8 + is_ + 0]) * q1
+            out[n * 128 + l + 32] = d * int(scales[n * 8 + is_ + 2]) * q2
+            out[n * 128 + l + 64] = d * int(scales[n * 8 + is_ + 4]) * q3
+            out[n * 128 + l + 96] = d * int(scales[n * 8 + is_ + 6]) * q4
+    return out
+
+
+def _blocks(raw_per_block: list[bytes]) -> bytes:
+    return b"".join(raw_per_block)
+
+
+def test_q8_0_against_scalar_spec():
+    blocks = []
+    for _ in range(4):
+        blocks.append(_rand_f16(1).tobytes() + RNG.integers(-128, 128, 32, np.int8).tobytes())
+    want = [x for b in blocks for x in scalar_q8_0(b)]
+    got = dequantize(_blocks(blocks), GGMLType.Q8_0, len(blocks) * 32)
+    np.testing.assert_allclose(np.asarray(got, np.float32).ravel(), want, rtol=1e-6)
+
+
+def test_q4_0_against_scalar_spec():
+    blocks = []
+    for _ in range(4):
+        blocks.append(_rand_f16(1).tobytes() + RNG.integers(0, 256, 16, np.uint8).tobytes())
+    want = [x for b in blocks for x in scalar_q4_0(b)]
+    got = dequantize(_blocks(blocks), GGMLType.Q4_0, len(blocks) * 32)
+    np.testing.assert_allclose(np.asarray(got, np.float32).ravel(), want, rtol=1e-6)
+
+
+def test_q4_k_against_scalar_spec():
+    blocks = []
+    for _ in range(3):
+        blocks.append(
+            _rand_f16(2).tobytes()
+            + RNG.integers(0, 256, 12, np.uint8).tobytes()
+            + RNG.integers(0, 256, 128, np.uint8).tobytes()
+        )
+    want = [x for b in blocks for x in scalar_q4_k(b)]
+    got = dequantize(_blocks(blocks), GGMLType.Q4_K, len(blocks) * 256)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32).ravel(), want, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_q6_k_against_scalar_spec():
+    blocks = []
+    for _ in range(3):
+        blocks.append(
+            RNG.integers(0, 256, 128, np.uint8).tobytes()  # ql
+            + RNG.integers(0, 256, 64, np.uint8).tobytes()  # qh
+            + RNG.integers(-64, 64, 16, np.int8).tobytes()  # scales
+            + _rand_f16(1).tobytes()
+        )
+    want = [x for b in blocks for x in scalar_q6_k(b)]
+    got = dequantize(_blocks(blocks), GGMLType.Q6_K, len(blocks) * 256)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32).ravel(), want, rtol=1e-5, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# tokenizer goldens (hand-computed, not writer round-trips)
+# ---------------------------------------------------------------------------
+
+
+def test_byte_level_bpe_known_mapping_and_merge():
+    """GPT-2 byte-level facts verifiable by hand: printable ASCII maps to
+    itself, space maps to U+0120 ('Ġ'), and a single merge applies."""
+    from nats_llm_studio_tpu.gguf.tokenizer import GGUFTokenizer
+
+    vocab = ["A", "B", "AB", "Ġ", "ĠA", "C"]
+    tok = GGUFTokenizer("gpt2", vocab, merges=["A B", "Ġ A"], add_bos=False)
+    assert tok.encode("AB") == [2]  # merge "A B" -> "AB"
+    assert tok.encode(" A") == [4]  # space -> Ġ, then merge "Ġ A"
+    assert tok.encode("BA") == [1, 0]  # no merge defined for "B A"
+    assert tok.decode([2, 3, 0]) == "AB A"  # Ġ decodes back to a space
+
+
+def test_spm_known_greedy_merge():
+    """SPM scores: higher score wins; ' ab' -> '▁ab' when that piece exists
+    and outranks the alternatives (computed by hand)."""
+    from nats_llm_studio_tpu.gguf.tokenizer import GGUFTokenizer
+
+    vocab = ["<unk>", "▁", "a", "b", "ab", "▁a", "▁ab"]
+    scores = [0.0, -10.0, -3.0, -3.0, -1.0, -2.0, -0.5]
+    tok = GGUFTokenizer(
+        "llama", vocab, scores=scores, bos_id=None, eos_id=None, add_bos=False
+    )
+    assert tok.encode("ab") == [6]  # SPM prefixes ' ', best single piece '▁ab'
+    assert tok.decode([6]) == "ab"  # leading ▁ restores then strips the space
+    assert tok.decode([5, 3]) == "ab"
+
+
+# ---------------------------------------------------------------------------
+# real nats-server interop (runs wherever the binary exists)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(shutil.which("nats-server") is None, reason="nats-server not installed")
+def test_client_against_real_nats_server(tmp_path):
+    """The in-tree client must speak to a stock nats-server: connect, PING,
+    request/reply via a subscriber — proving the wire protocol is real NATS,
+    not merely self-consistent with the in-tree broker."""
+    import asyncio
+    import socket
+    import subprocess
+    import time
+
+    from nats_llm_studio_tpu.transport import connect
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        ["nats-server", "-a", "127.0.0.1", "-p", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=0.5).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+
+        async def drive():
+            nc = await connect(f"nats://127.0.0.1:{port}")
+            sub = await nc.subscribe("echo.svc")
+
+            async def responder():
+                async for msg in sub.messages():
+                    await nc.publish(msg.reply, b"pong:" + msg.payload)
+                    break
+
+            task = asyncio.ensure_future(responder())
+            reply = await nc.request("echo.svc", b"hi", timeout=5.0)
+            assert reply.payload == b"pong:hi"
+            task.cancel()
+            await nc.close()
+
+        asyncio.run(drive())
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -149,3 +149,39 @@ def test_streaming_sharded_loader_moe(tmp_path):
     k, v = shard_cache(*make_cache(cfg, 2, 8), mesh)
     got, _, _ = forward(streamed, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32))
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_sp_ring_prefill_matches_dense():
+    """Sequence-parallel prefill: dp x sp mesh routes the fresh-block
+    attention through ring_attention (T sharded on sp, K/V rotating via
+    ppermute) and must reproduce the unsharded logits and cache, then decode
+    consistently on the sp-sharded cache (VERDICT round-1 item 8)."""
+    cfg = ModelConfig.tiny(
+        n_heads=8, n_kv_heads=8, head_dim=8, d_model=64, d_ff=128, max_seq_len=64
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t = 8  # divisible by sp=4
+    tokens = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8], [9, 8, 7, 6, 5, 4, 3, 2]], jnp.int32)
+
+    k, v = make_cache(cfg, 2, 16)
+    ref, k_ref, v_ref = forward(
+        params, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32)
+    )
+
+    mesh = build_mesh("dp=2,sp=4")
+    validate_mesh_for_config(mesh, cfg.with_(max_seq_len=16))
+    sp_params = shard_params(params, mesh)
+    k, v = make_cache(cfg, 2, 16)
+    k, v = shard_cache(k, v, mesh)
+    got, k2, v2 = forward(
+        sp_params, cfg, tokens, k, v, jnp.zeros((2,), jnp.int32), mesh=mesh
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(k2), np.asarray(k_ref), rtol=2e-3, atol=2e-3)
+
+    # decode one token on the sp-sharded cache
+    nxt = jnp.asarray([[11], [12]], jnp.int32)
+    pos = jnp.full((2,), t, jnp.int32)
+    want, _, _ = forward(params, cfg, nxt, k_ref, v_ref, pos)
+    got2, _, _ = forward(sp_params, cfg, nxt, k2, v2, pos, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want), rtol=2e-3, atol=2e-3)
