@@ -124,7 +124,8 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
         await worker.drain()
         await broker.stop()
         batcher.stop()
-        ttfts = sorted(r[0] * 1e3 for r in results)
+        # a stream whose very first token is a stop token has no TTFT sample
+        ttfts = sorted(r[0] * 1e3 for r in results if r[0] == r[0]) or [0.0]
         total_toks = sum(r[1] for r in results)
         return {
             "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),

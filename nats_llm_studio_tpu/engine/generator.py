@@ -1,10 +1,23 @@
-"""Autoregressive generation driver around the jitted forward pass.
+"""Single-stream autoregressive generation: the REFERENCE decode loop.
 
-Design for TTFT (SURVEY.md §7 hard part #1): prompt lengths are padded to a
-small set of bucket shapes so XLA compiles a handful of prefill programs
-instead of one per length; ``warmup()`` pre-compiles them ahead of traffic.
-Decode is a single fused jit step (forward + sample) whose only host traffic
-is the sampled token id.
+Two decode implementations exist on purpose and serve different roles:
+
+* ``serve.batcher.ContinuousBatcher`` is the serving path — fixed-width
+  batched slots, ring cache, burst decode, chunked prefill. Every
+  throughput/latency trick lives there.
+* ``Generator`` (this module) is the deliberately simple positional loop —
+  one stream, per-position cache writes, token-at-a-time. The batcher's
+  correctness tests hold the batcher to Generator's greedy output exactly
+  (tests/test_batcher.py), the way the quant layer is held to scalar
+  from-spec decoders: an independent implementation that a shared bug
+  cannot hide behind. It is also the zero-setup library API for scripts.
+
+Shared pieces (SamplingParams, bucket policy) are defined here and imported
+by the batcher, so the two paths cannot drift on request semantics.
+
+Prompt lengths are padded to a small set of bucket shapes so XLA compiles a
+handful of prefill programs instead of one per length; ``warmup()``
+pre-compiles them ahead of traffic (SURVEY.md §7 hard part #1).
 """
 
 from __future__ import annotations

@@ -25,42 +25,55 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_q: int, block_k: int):
-    # refs are [1, 1, T, D] blocks of the [B, H, T, D] layout (T and D in the
-    # last two positions to satisfy Mosaic's (8, 128) tiling rule)
-    qt = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [BQ, D]
-    d = q.shape[-1]
-    n_kv = k_ref.shape[2]
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, block_q: int, block_k: int
+):
+    """One grid step = one (batch, q-head, q-tile, K-TILE). K/V arrive one
+    [BK, D] tile per step — VMEM stays O(block) at any sequence length (the
+    whole-K-per-cell layout capped prefill at ~8k tokens). Online-softmax
+    state persists in scratch across the key-tile axis; causally-dead tiles
+    skip compute (pl.when) and DMA (their index map revisits the previous
+    tile, which the pipeline elides)."""
+    qt, kt = pl.program_id(2), pl.program_id(3)
+    d = q_ref.shape[-1]
 
-    q_pos = qt * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    @pl.when(kt == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(kt, carry):
-        acc, m, l = carry
-        k = k_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, 0, pl.ds(kt * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kt * block_k <= (qt + 1) * block_q - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BK, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
-        k_pos = kt * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        q_pos = qt * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kt * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
         s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    # causal: key tiles strictly after this query tile are fully masked
-    n_tiles = jnp.minimum((qt + 1) * block_q + block_k - 1, n_kv + block_k - 1) // block_k
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_tiles, body, (acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]
-    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+    @pl.when(kt == pl.num_programs(3) - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret"))
@@ -99,7 +112,13 @@ def flash_attention(
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
 
-    grid = (b, hq, tq // block_q)
+    def kv_map(bi, hi, qi, ki, g=group):
+        # causal revisit-skip: tiles past this q-tile's last live key tile
+        # remap to it, so their DMA is elided by the pipeline
+        live = ((qi + 1) * block_q - 1) // block_k
+        return (bi, hi // g, jnp.minimum(ki, live), 0)
+
+    grid = (b, hq, tq // block_q, tk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
     )
@@ -107,12 +126,19 @@ def flash_attention(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
-            pl.BlockSpec((1, 1, tk, d), lambda bi, hi, qi, g=group: (bi, hi // g, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
         out_shape=jax.ShapeDtypeStruct((b, hq, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
         interpret=interpret,
     )(qh, kh, vh)
     return out.transpose(0, 2, 1, 3)[:, :t]

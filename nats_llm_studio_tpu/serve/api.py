@@ -78,3 +78,8 @@ class Registry(ABC):
 
     def stats(self) -> dict[str, Any]:
         return {}
+
+    def loaded_engines(self) -> dict[str, "ChatEngine"]:
+        """Currently-loaded engines by model id, for metrics/observability.
+        Default: none (registries without persistent engines)."""
+        return {}

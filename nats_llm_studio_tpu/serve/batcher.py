@@ -98,8 +98,13 @@ class ContinuousBatcher:
         # would otherwise write past the cache end, where dynamic-update-
         # slice clamps the start and corrupts earlier prefix slots.
         self.prefill_chunk = max(8, prefill_chunk)
-        while self.max_seq % self.prefill_chunk:
+        while self.max_seq % self.prefill_chunk and self.prefill_chunk > 8:
             self.prefill_chunk //= 2
+        if self.max_seq % self.prefill_chunk:
+            raise ValueError(
+                f"max_seq_len={self.max_seq} must be divisible by a prefill "
+                f"chunk >= 8; use a power-of-two max_seq_len"
+            )
         # decode runs ``decode_burst`` steps per dispatch (one on-device
         # lax.scan): host<->device round trips dominate per-step cost on a
         # tunneled chip (~50-100 ms each vs a ~3 ms device step), so tokens
@@ -306,10 +311,11 @@ class ContinuousBatcher:
                 topk = jnp.asarray([r.sp.top_k if r else 0 for r in self._slots], jnp.int32)
                 topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in self._slots], jnp.float32)
                 dirty = False
-            # cap the burst so no active row can run past the cache capacity
-            n = self.decode_burst
+            # cap the burst so no active row can run past the cache capacity.
+            # n is a static jit arg: snap to single steps near capacity
+            # instead of counting down through n-1 fresh compiles
             headroom = self.max_seq - 1 - max(host_pos[i] for i in act)
-            n = max(1, min(n, headroom))
+            n = self.decode_burst if headroom >= self.decode_burst else 1
             tok = jnp.asarray(host_tok, jnp.int32)
             pos = jnp.asarray(host_pos, jnp.int32)
             seeds = jnp.asarray(host_seed, jnp.int32)

@@ -309,6 +309,9 @@ class LocalRegistry(Registry):
             model_id, batcher, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
         )
 
+    def loaded_engines(self) -> dict[str, Any]:
+        return dict(self._engines)
+
     def stats(self) -> dict[str, Any]:
         out: dict[str, Any] = {
             "models_cached": len(self.store.cached()),

@@ -63,6 +63,8 @@ class Worker:
             cfg.subject("chat_model"): self.on_chat_model,
             cfg.subject("sync_model_from_bucket"): self.on_sync_model_from_bucket,
             cfg.subject("health"): self.on_health,
+            cfg.subject("metrics"): self.on_metrics,
+            cfg.subject("profile"): self.on_profile,
         }
         for subject, handler in subs.items():
             await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
@@ -305,3 +307,53 @@ class Worker:
         }
         data.update(self.registry.stats())
         await self._respond_ok(msg, data)
+
+    async def on_metrics(self, msg: Msg) -> None:
+        """metrics — full observability snapshot (SURVEY.md §5: counters on a
+        NATS metrics subject): worker totals plus per-engine batcher stats
+        (decode steps, tokens/step, peak active slots) and device info."""
+        import jax
+
+        engines = {}
+        for mid, eng in self.registry.loaded_engines().items():
+            batcher = getattr(eng, "batcher", None)
+            if batcher is not None and hasattr(batcher, "stats"):
+                engines[mid] = batcher.stats.snapshot()
+        devices = [
+            {"id": d.id, "platform": d.platform, "kind": d.device_kind}
+            for d in jax.devices()
+        ]
+        data = {
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "requests_total": self._requests_total,
+            "tokens_total": self._tokens_total,
+            "queue_group": self.config.queue_group,
+            "registry": self.registry.stats(),
+            "engines": engines,
+            "devices": devices,
+        }
+        await self._respond_ok(msg, data)
+
+    async def on_profile(self, msg: Msg) -> None:
+        """profile — capture a jax.profiler device trace for ``seconds``
+        (default 2) into ``dir`` (default under /tmp) and reply with the
+        trace path. The SURVEY.md §5 profiling endpoint: drive load through
+        chat_model while this runs, then inspect the trace with the
+        TensorBoard profile plugin."""
+        import tempfile
+
+        import jax
+
+        try:
+            req = json.loads(msg.payload) if msg.payload.strip() else {}
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in Profile: {e}")
+            return
+        seconds = min(float(req.get("seconds", 2.0)), 60.0)
+        trace_dir = req.get("dir") or tempfile.mkdtemp(prefix="tpu_trace_")
+        jax.profiler.start_trace(trace_dir)
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        await self._respond_ok(msg, {"trace_dir": trace_dir, "seconds": seconds})

@@ -12,11 +12,13 @@ fixed here: ids are always ``publisher/model`` and never re-prefixed.
 from __future__ import annotations
 
 import asyncio
+import os
 import shutil
 from dataclasses import dataclass
 from pathlib import Path
 
 from ..transport.jetstream import ObjectNotFound, ObjectStore
+from ..utils.nuid import next_nuid
 
 
 class StoreError(Exception):
@@ -146,10 +148,13 @@ class ModelStore:
         """Fetch a model from the bucket into the local cache (the `lms get`
         replacement, nats_llm_studio.go:46-59; conceptual sync flow
         README.md:286-318). ``identifier`` is an object name
-        ``publisher/model/file.gguf`` or a model id ``publisher/model``;
-        ``model_id`` overrides the cache location (README.md:306 lets the
-        sync flow choose the local model dir). Returns (local_path,
-        transcript)."""
+        ``publisher/model/file.gguf``, a model id ``publisher/model``, or an
+        ``http(s)://`` / ``file://`` URL to a GGUF (the catalog-download
+        capability `lms get` has for public models); ``model_id`` overrides
+        the cache location (README.md:306 lets the sync flow choose the
+        local model dir). Returns (local_path, transcript)."""
+        if identifier.startswith(("http://", "https://", "file://")):
+            return await self._pull_url(identifier, model_id)
         store = self._require_store()
         lines = [f"pulling {identifier!r} from bucket {self.bucket!r}"]
         obj_name = identifier.strip().strip("/")
@@ -175,8 +180,9 @@ class ModelStore:
         dest = dest_dir / fname
         # stream chunk-at-a-time into a temp file: peak RAM is O(chunk), not
         # O(object) — a 40 GB GGUF must not be materialized (VERDICT weak #6);
-        # the rename commits only after size+digest verify in get_chunks
-        tmp = dest.with_suffix(dest.suffix + ".part")
+        # the rename commits only after size+digest verify in get_chunks.
+        # Unique temp per pull: concurrent pulls must not interleave writes.
+        tmp = dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
         total = 0
         try:
             with open(tmp, "wb") as f:
@@ -197,3 +203,47 @@ class ModelStore:
         tmp.replace(dest)
         lines.append(f"wrote {total} bytes to {dest}")
         return dest, "\n".join(lines)
+
+    async def _pull_url(self, url: str, model_id: str | None) -> tuple[Path, str]:
+        """Stream a GGUF from an HTTP(S)/file URL into the local cache —
+        restores the reference's `lms get <any catalog model>` capability
+        (nats_llm_studio.go:46-59) without the LM Studio catalog."""
+        import urllib.parse
+        import urllib.request
+
+        fname = Path(urllib.parse.urlparse(url).path).name or "model.gguf"
+        if not fname.endswith(".gguf"):
+            raise StoreError(f"URL pull expects a .gguf file, got {fname!r}")
+        mid = model_id or f"downloads/{fname.removesuffix('.gguf')}"
+        dest_dir = self.model_dir(mid)
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        dest = dest_dir / fname
+        # unique temp per pull: concurrent pulls of the same URL must not
+        # interleave writes into a shared .part file
+        tmp = dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
+
+        def fetch() -> int:
+            total = 0
+            with urllib.request.urlopen(url, timeout=60.0) as r, open(tmp, "wb") as f:
+                expect = r.headers.get("Content-Length")
+                while True:
+                    chunk = r.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    total += len(chunk)
+            # a premature close makes read() return b'' without an error —
+            # verify against the advertised size before committing
+            if expect is not None and total != int(expect):
+                raise OSError(f"truncated download: got {total} of {expect} bytes")
+            return total
+
+        try:
+            total = await asyncio.to_thread(fetch)
+        except BaseException as e:
+            tmp.unlink(missing_ok=True)
+            if isinstance(e, (OSError, ValueError)):
+                raise StoreError(f"download failed for {url!r}: {e}") from None
+            raise
+        tmp.replace(dest)
+        return dest, f"downloaded {url!r}\nwrote {total} bytes to {dest}"

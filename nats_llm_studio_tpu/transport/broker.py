@@ -25,7 +25,7 @@ from . import protocol as p
 
 log = logging.getLogger(__name__)
 
-MAX_PAYLOAD = 8 * 1024 * 1024  # > default 1 MiB: model blob chunks ride NATS
+MAX_PAYLOAD = 1024 * 1024  # real nats-server's default; chunks are 128 KiB
 
 
 @dataclass(slots=True)

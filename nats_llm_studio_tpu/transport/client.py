@@ -178,6 +178,14 @@ class NatsClient:
         reply: str | None = None,
         headers: dict[str, str] | None = None,
     ) -> None:
+        # client-side guard, same as nats.go/nats.py: the server would answer
+        # a violation with -ERR (and real nats-server drops the connection),
+        # so fail fast with the advertised limit instead
+        limit = (self.server_info or {}).get("max_payload")
+        if limit and len(payload) > int(limit):
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds server max_payload {limit}"
+            )
         await self._send(p.encode_pub(subject, payload, reply, headers))
 
     async def subscribe(

@@ -99,3 +99,6 @@ class FakeRegistry(Registry):
 
     def stats(self) -> dict:
         return {"models_loaded": sorted(self.engines)}
+
+    def loaded_engines(self) -> dict:
+        return dict(self.engines)

@@ -246,3 +246,23 @@ async def test_pull_with_model_id_override(tmp_path):
         assert ms.lookup("other/renamed") is not None
         assert ms.lookup("acme/original") is None
         assert path.read_bytes() == b"WEIGHTS"
+
+
+@async_test
+async def test_pull_from_file_url(tmp_path):
+    """Catalog-style pull: a file:// URL streams into the local cache under
+    a derived (or explicit) model id — the `lms get <public model>` analog."""
+    src = tmp_path / "src" / "mini.gguf"
+    src.parent.mkdir()
+    src.write_bytes(b"GGUF-mini-bytes" * 100)
+    ms = ModelStore(tmp_path / "models")
+    url = src.as_uri()
+    dest, transcript = await ms.pull(url)
+    assert dest.read_bytes() == src.read_bytes()
+    assert "downloads/mini" in str(dest)
+    dest2, _ = await ms.pull(url, model_id="acme/mini")
+    assert "acme/mini" in str(dest2)
+    with pytest.raises(StoreError):
+        await ms.pull("file:///nonexistent/nope.gguf")
+    with pytest.raises(StoreError):
+        await ms.pull("https://example.invalid/not-a-gguf.bin")

@@ -187,16 +187,22 @@ async def test_unsubscribe_stops_delivery():
 
 
 @async_test
-async def test_large_payload():
+async def test_large_payload_at_limit_and_over():
+    """max_payload matches real nats-server's 1 MiB default: a payload at
+    the limit passes, one over it is rejected at the broker (so in-tree
+    client defaults behave identically against a stock server)."""
     broker = await _broker()
     try:
         nc = await connect(broker.url)
         sub = await nc.subscribe("big")
         await nc.flush()
-        blob = bytes(range(256)) * (4 * 1024 * 4)  # 4 MiB
+        blob = bytes(range(256)) * 4096  # exactly 1 MiB
         await nc.publish("big", blob)
         msg = await sub.next_msg(timeout=10)
         assert msg.payload == blob
+        with pytest.raises((ValueError, ConnectionError)):
+            await nc.publish("big", blob + b"x")
+            await nc.flush()
         await nc.close()
     finally:
         await broker.stop()

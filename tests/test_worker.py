@@ -215,3 +215,37 @@ async def test_unexpected_exception_still_replies_error_envelope():
         await w.drain()
     finally:
         await broker.stop()
+
+
+@async_test
+async def test_metrics_subject():
+    """metrics — full observability snapshot: worker totals, registry stats,
+    per-engine batcher counters, device list (SURVEY.md §5)."""
+    async with Harness() as h:
+        resp = await h.req("metrics", {})
+        assert resp["ok"] is True
+        d = resp["data"]
+        assert d["requests_total"] >= 0
+        assert "registry" in d and "engines" in d
+        assert isinstance(d["devices"], list) and d["devices"]
+        assert {"id", "platform", "kind"} <= set(d["devices"][0])
+
+
+@async_test
+async def test_profile_subject(tmp_path):
+    """profile — captures a jax.profiler trace and replies with its path."""
+    import os
+
+    async with Harness() as h:
+        resp = await h.req(
+            "profile", {"seconds": 0.2, "dir": str(tmp_path / "trace")}, timeout=30.0
+        )
+        assert resp["ok"] is True
+        trace_dir = resp["data"]["trace_dir"]
+        assert os.path.isdir(trace_dir)
+        found = []
+        for root, _, files in os.walk(trace_dir):
+            found += files
+        assert found  # a trace artifact was written
+        bad = await h.req("profile", {"seconds": "xx"})
+        assert bad["ok"] is False
