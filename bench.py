@@ -183,15 +183,21 @@ def main() -> None:
         logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start)
         return sample(logits[:, -1, :], jax.random.PRNGKey(1), temperature=0.0), k, v
 
-    @partial(jax.jit, donate_argnums=(2, 3))
-    def decode(params, tok, k, v, pos):
+    def bucket_window(max_pos: int) -> int | None:
+        """Smallest 256-multiple covering every live slot (the batcher uses
+        its bucket list the same way pre-wrap); None = full cache."""
+        w = -(-(max_pos + 1) // 256) * 256
+        return w if w < seq_len else None
+
+    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(5,))
+    def decode(params, tok, k, v, pos, window):
         # serving-path decode: ring write slot == position (uniform rows)
         logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v, start_pos=pos,
-                           ring_slot=pos[0] % k.shape[3])
+                           ring_slot=pos[0] % k.shape[3], attn_window=window)
         return sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0), k, v
 
-    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4,))
-    def decode_n(params, tok, k, v, n, pos0):
+    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4, 6))
+    def decode_n(params, tok, k, v, n, pos0, window):
         """n decode steps as one device-side scan: measures chip throughput
         without per-step host dispatch (the remote-device tunnel costs ~ms per
         call, which would swamp a ~6 ms memory-bound step)."""
@@ -200,7 +206,8 @@ def main() -> None:
             tok, k, v = carry
             pos = pos0 + i
             logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v,
-                               start_pos=pos, ring_slot=pos[0] % k.shape[3])
+                               start_pos=pos, ring_slot=pos[0] % k.shape[3],
+                               attn_window=window)
             nxt = sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0)
             return (nxt, k, v), nxt
 
@@ -214,7 +221,8 @@ def main() -> None:
     # compile both programs
     tok, k, v = prefill(params, tokens, k, v, start)
     pos = jnp.full((batch,), prompt_len, jnp.int32)
-    tok, k, v = decode(params, tok, k, v, pos)
+    host_window = bucket_window(prompt_len + steps)
+    tok, k, v = decode(params, tok, k, v, pos, host_window)
     _sync(tok)
 
     # prefill latency (compiled)
@@ -229,18 +237,19 @@ def main() -> None:
     t0 = time.perf_counter()
     for i in range(steps):
         pos = jnp.full((batch,), prompt_len + 1 + i, jnp.int32)
-        tok, k, v = decode(params, tok, k, v, pos)
+        tok, k, v = decode(params, tok, k, v, pos, host_window)
     _sync(tok)
     host_dt = time.perf_counter() - t0
     host_tok_s = batch * steps / host_dt
 
     # device-side scan loop (chip throughput) — compile, then time a fresh run
     pos0 = jnp.full((batch,), prompt_len + 1 + steps, jnp.int32)
-    tok, k, v, _ = decode_n(params, tok, k, v, steps, pos0)
+    window = bucket_window(prompt_len + 1 + 3 * steps)
+    tok, k, v, _ = decode_n(params, tok, k, v, steps, pos0, window)
     _sync(tok)
     pos0 = pos0 + steps
     t0 = time.perf_counter()
-    tok, k, v, toks = decode_n(params, tok, k, v, steps, pos0)
+    tok, k, v, toks = decode_n(params, tok, k, v, steps, pos0, window)
     _sync(toks)
     dt = time.perf_counter() - t0
     tok_s = batch * steps / dt

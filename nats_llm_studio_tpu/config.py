@@ -42,6 +42,10 @@ class WorkerConfig:
     # "none" (serve in cfg dtype) or "int8" (weight-only per-channel int8:
     # halves HBM weight traffic and fits 70B-class models on a v5e-8)
     quant_mode: str = field(default_factory=lambda: _env("TPU_QUANT", "none"))
+    # comma-separated URL schemes pull_model may fetch directly; https-only
+    # by default on serving workers (bus clients must not be able to SSRF
+    # through the worker or read its local files). Empty string disables.
+    url_pull_schemes: str = field(default_factory=lambda: _env("URL_PULL_SCHEMES", "https"))
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

@@ -71,7 +71,9 @@ async def _run_serve(args: argparse.Namespace) -> None:
         log.info("mesh: %s", dict(mesh.shape))
 
     nc = await connect(cfg.nats_url, name="store-client")
-    store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket)
+    schemes = tuple(s for s in cfg.url_pull_schemes.split(",") if s)
+    store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket,
+                       url_schemes=schemes)
     registry = LocalRegistry(
         store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots,
         quant=cfg.quant_mode,

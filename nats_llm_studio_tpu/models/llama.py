@@ -92,18 +92,15 @@ def _attention_block(
             v_all, upd_v, (zero, layer, zero, ring_slot, zero)
         )
 
-        # valid slots may wrap around the ring, so attention always reads
-        # the full S axis (attn_window does not apply here)
-        def full_slice(cache):
-            sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
-                                       (b, 1, hkv, s_max, d))
-            return sl[:, 0]
-
+        # attn_window in ring mode is the caller's promise that the ring has
+        # not wrapped yet (ring_slot < window and all live tokens sit below
+        # it) — then reading cache[:, :, :win] is complete. After the first
+        # wrap the caller must pass None and attention reads the full ring.
         out = gqa_attention_hmajor(
             q,
-            full_slice(k_all).astype(q.dtype),
-            full_slice(v_all).astype(q.dtype),
-            mask,
+            layer_slice(k_all).astype(q.dtype),
+            layer_slice(v_all).astype(q.dtype),
+            mask[:, :, :win],
             cfg.attn_scale,
         )
         return mm(out.reshape(b, t, hq * d), p["wo"]), k_all, v_all

@@ -172,16 +172,21 @@ class ContinuousBatcher:
 
         max_seq = self.max_seq
 
-        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11,))
-        def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp, n):
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11, 12))
+        def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp,
+                   n, window):
             """n decode steps in one dispatch (device-side scan): the host
-            sees one transfer in and one [B, n] token readback."""
+            sees one transfer in and one [B, n] token readback. ``window``
+            (static) bounds attention reads to the live ring prefix while
+            the ring has not wrapped — the dominant HBM saving at partial
+            cache occupancy (~35% step time at half-full, granite-2b b32)."""
 
             def body(carry, i):
                 tok, K, V = carry
                 logits, K, V = fwd(
                     params, tokens=tok[:, None], k_cache=K, v_cache=V,
                     start_pos=pos + i, ring_slot=(ring + i) % max_seq,
+                    attn_window=window,
                 )
                 nxt = sample_rows(logits[:, -1, :], seeds, steps + i, temp, topk, topp)
                 return (nxt, K, V), nxt
@@ -278,6 +283,7 @@ class ContinuousBatcher:
         # ring head: the shared cache slot the next decode step writes; rows'
         # validity is "my last pos+1 ring slots", see models.llama.forward
         self._ring_next = 0
+        self._ring_wrapped = False  # once True, windowed reads are unsafe
         K, V = make_cache(cfg, B, self.max_seq)
         if self.mesh is not None:
             from ..parallel.sharding import shard_cache
@@ -316,6 +322,14 @@ class ContinuousBatcher:
             # instead of counting down through n-1 fresh compiles
             headroom = self.max_seq - 1 - max(host_pos[i] for i in act)
             n = self.decode_burst if headroom >= self.decode_burst else 1
+            # until the ring wraps, every live slot index is < ring_next:
+            # attention can read just a bucket covering the head (static
+            # windows come from self.buckets, so compiles stay bounded)
+            window = None
+            if not self._ring_wrapped:
+                w = self._bucket(self._ring_next + n)
+                if w < self.max_seq:
+                    window = w
             tok = jnp.asarray(host_tok, jnp.int32)
             pos = jnp.asarray(host_pos, jnp.int32)
             seeds = jnp.asarray(host_seed, jnp.int32)
@@ -324,8 +338,10 @@ class ContinuousBatcher:
             )
             toks, K, V = self._decode(
                 self.params, tok, K, V, pos, jnp.int32(self._ring_next),
-                seeds, steps, temp, topk, topp, n,
+                seeds, steps, temp, topk, topp, n, window,
             )
+            if self._ring_next + n >= self.max_seq:
+                self._ring_wrapped = True
             self._ring_next = (self._ring_next + n) % self.max_seq
             ids = np.asarray(toks)  # ONE [B, n] readback per burst
             self.stats.steps += n
@@ -355,6 +371,15 @@ class ContinuousBatcher:
                 jnp.int32(seed), jnp.float32(sp.temperature),
                 jnp.int32(sp.top_k), jnp.float32(sp.top_p),
             )
+            if not any(r is not None for r in self._slots):
+                # cold ring (no active rows): restart at the bottom so the
+                # first prefix lands at [0, n) and windowed reads can engage
+                self._ring_next = n
+                self._ring_wrapped = False
+            elif self._ring_next < n:
+                # the prefix placement wraps to the high slots: windowed
+                # reads would miss it from here on
+                self._ring_wrapped = True
             if n <= C:
                 # short prompt: the whole admit is one fused dispatch
                 bucket = self._bucket(n)

@@ -48,6 +48,7 @@ class Worker:
         self._stop = asyncio.Event()
         self._requests_total = 0
         self._tokens_total = 0
+        self._profiling = False
         self._t0 = time.monotonic()
 
     # -- lifecycle -----------------------------------------------------------
@@ -344,16 +345,29 @@ class Worker:
 
         import jax
 
+        import math
+
         try:
             req = json.loads(msg.payload) if msg.payload.strip() else {}
         except ValueError as e:
             await self._respond_error(msg, f"invalid JSON in Profile: {e}")
             return
-        seconds = min(float(req.get("seconds", 2.0)), 60.0)
+        seconds = float(req.get("seconds", 2.0))
+        if not math.isfinite(seconds):
+            await self._respond_error(msg, "'seconds' must be finite")
+            return
+        seconds = max(0.0, min(seconds, 60.0))
+        if self._profiling:
+            await self._respond_error(msg, "a profile capture is already running")
+            return
+        self._profiling = True
         trace_dir = req.get("dir") or tempfile.mkdtemp(prefix="tpu_trace_")
-        jax.profiler.start_trace(trace_dir)
         try:
-            await asyncio.sleep(seconds)
+            jax.profiler.start_trace(trace_dir)
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
         finally:
-            jax.profiler.stop_trace()
+            self._profiling = False
         await self._respond_ok(msg, {"trace_dir": trace_dir, "seconds": seconds})

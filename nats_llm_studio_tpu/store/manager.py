@@ -21,6 +21,12 @@ from ..transport.jetstream import ObjectNotFound, ObjectStore
 from ..utils.nuid import next_nuid
 
 
+
+def _tmp_part(dest_dir: Path, fname: str) -> Path:
+    """Unique temp path per pull: concurrent pulls of the same target must
+    not interleave writes into a shared .part file."""
+    return dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
+
 class StoreError(Exception):
     def __init__(self, msg: str, dir: str | None = None):
         super().__init__(msg)
@@ -59,11 +65,17 @@ class ModelStore:
     """Local cache directory + optional Object Store bucket."""
 
     def __init__(self, models_dir: str | Path, objstore: ObjectStore | None = None,
-                 bucket: str = "llm-models"):
+                 bucket: str = "llm-models",
+                 url_schemes: tuple[str, ...] = ("https", "http", "file")):
         self.models_dir = Path(models_dir).expanduser()
         self.models_dir.mkdir(parents=True, exist_ok=True)
         self.objstore = objstore
         self.bucket = bucket
+        # which URL schemes pull() may fetch. Library default is permissive;
+        # SERVING processes pass the config's (https-only by default) — a
+        # shared-bus client must not be able to drive the worker to GET
+        # internal endpoints or read local files into the served cache (SSRF)
+        self.url_schemes = tuple(url_schemes)
 
     # -- local cache ---------------------------------------------------------
 
@@ -154,6 +166,11 @@ class ModelStore:
         the cache location (README.md:306 lets the sync flow choose the
         local model dir). Returns (local_path, transcript)."""
         if identifier.startswith(("http://", "https://", "file://")):
+            scheme = identifier.split("://", 1)[0]
+            if scheme not in self.url_schemes:
+                raise StoreError(
+                    f"URL pulls via {scheme!r} are not allowed on this worker"
+                )
             return await self._pull_url(identifier, model_id)
         store = self._require_store()
         lines = [f"pulling {identifier!r} from bucket {self.bucket!r}"]
@@ -180,9 +197,8 @@ class ModelStore:
         dest = dest_dir / fname
         # stream chunk-at-a-time into a temp file: peak RAM is O(chunk), not
         # O(object) — a 40 GB GGUF must not be materialized (VERDICT weak #6);
-        # the rename commits only after size+digest verify in get_chunks.
-        # Unique temp per pull: concurrent pulls must not interleave writes.
-        tmp = dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
+        # the rename commits only after size+digest verify in get_chunks
+        tmp = _tmp_part(dest_dir, fname)
         total = 0
         try:
             with open(tmp, "wb") as f:
@@ -218,9 +234,7 @@ class ModelStore:
         dest_dir = self.model_dir(mid)
         dest_dir.mkdir(parents=True, exist_ok=True)
         dest = dest_dir / fname
-        # unique temp per pull: concurrent pulls of the same URL must not
-        # interleave writes into a shared .part file
-        tmp = dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
+        tmp = _tmp_part(dest_dir, fname)
 
         def fetch() -> int:
             total = 0

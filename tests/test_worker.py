@@ -249,3 +249,5 @@ async def test_profile_subject(tmp_path):
         assert found  # a trace artifact was written
         bad = await h.req("profile", {"seconds": "xx"})
         assert bad["ok"] is False
+        nan = await h.req("profile", b'{"seconds": NaN}')
+        assert nan["ok"] is False and "finite" in nan["error"]
