@@ -116,7 +116,10 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
                 n_tok += 1
             return ttft if ttft is not None else float("nan"), n_tok, time.perf_counter() - t0
 
-        await one_chat(0)  # compile warmup (prefill bucket + decode)
+        # compile warmup at the measured shape: single admit, the batched
+        # n_concurrent admit, and the decode burst all trace here
+        await one_chat(0)
+        await asyncio.gather(*(one_chat(100 + i) for i in range(n_concurrent)))
         t0 = time.perf_counter()
         results = await asyncio.gather(*(one_chat(i + 1) for i in range(n_concurrent)))
         wall = time.perf_counter() - t0
@@ -161,17 +164,26 @@ def main() -> None:
         if quant != "int8":
             return params
         # quantize on device: per-leaf absmax/round is fast there and avoids
-        # a 5 GB host round-trip
+        # a 5 GB host round-trip. Pop leaves as they quantize so the bf16
+        # originals free eagerly — holding both copies OOMs at batch >= 48.
         from nats_llm_studio_tpu.ops.wquant import quantizable, quantize_weight
 
         def q(path, leaf):
-            return quantize_weight(leaf, device=True) if quantizable(path) else leaf
+            if not quantizable(path):
+                return leaf
+            out = quantize_weight(leaf, device=True)
+            jax.block_until_ready(out.q)
+            return out
 
+        blocks = params.pop("blocks")
+        out_blocks = {}
+        for key in list(blocks.keys()):
+            out_blocks[key] = q(key, blocks.pop(key))
         return {
             "embed": params["embed"],
             "out_norm": params["out_norm"],
-            "lm_head": q("lm_head", params["lm_head"]),
-            "blocks": {k: q(k, v) for k, v in params["blocks"].items()},
+            "lm_head": q("lm_head", params.pop("lm_head")),
+            "blocks": out_blocks,
         }
 
     params = build_params()

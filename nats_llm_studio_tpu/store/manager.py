@@ -236,9 +236,25 @@ class ModelStore:
         dest = dest_dir / fname
         tmp = _tmp_part(dest_dir, fname)
 
+        allowed = self.url_schemes
+
+        class _SchemeGuardRedirect(urllib.request.HTTPRedirectHandler):
+            # urlopen follows cross-scheme redirects; without this a
+            # https-only allowlist could still be driven to http://
+            # internal endpoints via a 302 (the SSRF the gate exists for)
+            def redirect_request(self, req, fp, code, msg, headers, newurl):
+                scheme = urllib.parse.urlparse(newurl).scheme
+                if scheme not in allowed:
+                    raise OSError(
+                        f"redirect to disallowed scheme {scheme!r}: {newurl}"
+                    )
+                return super().redirect_request(req, fp, code, msg, headers, newurl)
+
+        opener = urllib.request.build_opener(_SchemeGuardRedirect())
+
         def fetch() -> int:
             total = 0
-            with urllib.request.urlopen(url, timeout=60.0) as r, open(tmp, "wb") as f:
+            with opener.open(url, timeout=60.0) as r, open(tmp, "wb") as f:
                 expect = r.headers.get("Content-Length")
                 while True:
                     chunk = r.read(1 << 20)

@@ -217,3 +217,31 @@ async def test_chunked_prefill_interleaves_decode(model):
         assert b_toks == reference_greedy(cfg, params, long_prompt, 4)
     finally:
         b.stop()
+
+
+@async_test
+async def test_group_admit_deterministic(model):
+    """Force the batched-admission path deterministically: fill the inbox
+    BEFORE starting the owner thread so all requests form one group, and
+    check every stream against the single-stream reference (pins the
+    per-row offset/placement/last-logit math, including mixed lengths in
+    one bucket and pad-rows-repeat-row-0)."""
+    cfg, params = model
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [5], [10, 20, 30, 40, 50], [2, 4]]
+    want = [reference_greedy(cfg, params, p, 5) for p in prompts]
+    b = ContinuousBatcher(params, cfg, max_slots=8, max_seq_len=64, buckets=[8, 64])
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=5)
+            return [t async for t in b.submit(p, sp)]
+
+        # enqueue all submissions in one loop tick; the batcher thread starts
+        # on the first submit and drains the inbox as one waitlist -> one
+        # grouped admit (5 requests -> mpad 8, 3 pad rows repeating row 0)
+        tasks = [asyncio.create_task(run(p)) for p in prompts]
+        await asyncio.sleep(0)  # let every submit enqueue before work starts
+        got = await asyncio.gather(*tasks)
+        assert list(got) == want
+        assert b.stats.requests == len(prompts)
+    finally:
+        b.stop()
