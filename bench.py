@@ -116,10 +116,14 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
                 n_tok += 1
             return ttft if ttft is not None else float("nan"), n_tok, time.perf_counter() - t0
 
-        # compile warmup at the measured shape: single admit, the batched
-        # n_concurrent admit, and the decode burst all trace here
+        # compile warmup: single admit, every padded group-admit width the
+        # measured phase might split into (mpad in {2, 4, ..}), and the
+        # decode burst — so no XLA compile lands inside the timed window
         await one_chat(0)
-        await asyncio.gather(*(one_chat(100 + i) for i in range(n_concurrent)))
+        w = 2
+        while w <= n_concurrent:
+            await asyncio.gather(*(one_chat(100 * w + i) for i in range(w)))
+            w *= 2
         t0 = time.perf_counter()
         results = await asyncio.gather(*(one_chat(i + 1) for i in range(n_concurrent)))
         wall = time.perf_counter() - t0

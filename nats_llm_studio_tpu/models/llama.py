@@ -71,6 +71,17 @@ def _attention_block(
             return cache[:, layer, :, :win]
         sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
                                    (b, 1, hkv, win, d))
+        if t == 1 and mesh is None and jax.default_backend() == "tpu":
+            # The attention dot wants the slice S-minor while the cache at
+            # rest is write-friendly D/B-minor; left alone, XLA materializes
+            # the slice AND relayout-copies it (~300 us/layer at batch 32 —
+            # half the decode step). Constraining the slice's layout merges
+            # both into one pass: 19.3 -> 15.6 ms/step (granite-2b b32).
+            from jax.experimental.layout import Layout, with_layout_constraint
+
+            sl = with_layout_constraint(
+                sl, Layout(major_to_minor=(1, 0, 2, 4, 3))
+            )
         return sl[:, 0]
 
     if t == 1 and ring_slot is not None:

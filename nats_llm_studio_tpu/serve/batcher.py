@@ -57,6 +57,7 @@ class BatcherStats:
     tokens: int = 0
     steps: int = 0
     peak_active: int = 0
+    grouped_admits: int = 0  # requests admitted via the batched-admit path
 
     def snapshot(self) -> dict:
         return {
@@ -64,6 +65,7 @@ class BatcherStats:
             "tokens": self.tokens,
             "decode_steps": self.steps,
             "peak_active_slots": self.peak_active,
+            "grouped_admits": self.grouped_admits,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
         }
 
@@ -524,6 +526,7 @@ class ContinuousBatcher:
                     self._slots[s] = None
                 raise
             dirty = True
+            self.stats.grouped_admits += len(reqs)
             for j, r in enumerate(reqs):
                 s = slots[j]
                 r.slot = s

@@ -243,5 +243,8 @@ async def test_group_admit_deterministic(model):
         got = await asyncio.gather(*tasks)
         assert list(got) == want
         assert b.stats.requests == len(prompts)
+        # the batched path must actually have run — without this the test
+        # could silently degrade to admit_one coverage on timing changes
+        assert b.stats.grouped_admits >= 2, b.stats.snapshot()
     finally:
         b.stop()
