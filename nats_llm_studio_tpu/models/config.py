@@ -33,6 +33,11 @@ class ModelConfig:
     residual_scale: float = 1.0
     attention_scale: float | None = None  # None -> 1/sqrt(head_dim)
     logit_scale: float = 1.0
+    # Qwen2-family: QKV projections carry biases
+    attn_bias: bool = False
+    # Gemma-family: GELU MLP and RMSNorm computing x * (1 + w)
+    mlp_act: str = "silu"  # "silu" | "gelu"
+    norm_plus_one: bool = False
     dtype: str = "bfloat16"  # compute/weight dtype name (tests use float32)
     # Pallas flash-attention for prefill (requires prefill at start_pos 0,
     # which the engine guarantees); decode keeps the fused XLA path
@@ -76,7 +81,31 @@ class ModelConfig:
         if vocab is None:
             toks = md.get("tokenizer.ggml.tokens")
             vocab = len(toks) if toks is not None else 32000
-        return cls(
+        # architecture-family quirks beyond the metadata keys (the same
+        # special-casing llama.cpp's build_* graph constructors apply).
+        # Families whose topology this model does NOT implement are rejected
+        # loudly — half-running them (dropped shared experts, missing
+        # post-norms/softcapping) would load fine and produce garbage.
+        if arch in ("gemma2", "gemma3", "qwen2moe"):
+            raise NotImplementedError(
+                f"architecture {arch!r} needs topology this model does not "
+                "implement (post-norms/softcapping or shared experts)"
+            )
+        family: dict[str, Any] = {}
+        if arch == "qwen2":
+            family["attn_bias"] = True
+        elif arch == "gemma":
+            # NOTE: no norm_plus_one here — llama.cpp's GGUF converter folds
+            # gemma's (1+w) into the stored norm weights, so GGUF-loaded
+            # models use the plain multiply. The flag exists for checkpoints
+            # that keep the HF convention.
+            family |= {
+                "mlp_act": "gelu",
+                "tie_embeddings": True,
+                # gemma scales embeddings by sqrt(d_model)
+                "embedding_scale": float(d_model) ** 0.5,
+            }
+        kwargs: dict[str, Any] = dict(
             arch=arch,
             vocab_size=int(vocab),
             d_model=d_model,
@@ -99,6 +128,8 @@ class ModelConfig:
             # final logits by 1/f_logit_scale); internally we keep a multiplier
             logit_scale=1.0 / float(g("logit_scale", 1.0)),
         )
+        kwargs.update(family)  # family quirks win over absent metadata keys
+        return cls(**kwargs)
 
     @classmethod
     def tiny(cls, **kw: Any) -> "ModelConfig":

@@ -97,6 +97,12 @@ def export_params_to_gguf(
         put(f"{pre}.attn_k.weight", wk.T, quant)
         put(f"{pre}.attn_v.weight", layer("wv").T, quant)
         put(f"{pre}.attn_output.weight", layer("wo").T, quant)
+        if cfg.attn_bias:
+            bq = _rope_interleave(layer("bq")[None], cfg.n_heads, cfg.head_dim)[0]
+            bk = _rope_interleave(layer("bk")[None], cfg.n_kv_heads, cfg.head_dim)[0]
+            put(f"{pre}.attn_q.bias", bq, GGMLType.F32)
+            put(f"{pre}.attn_k.bias", bk, GGMLType.F32)
+            put(f"{pre}.attn_v.bias", layer("bv"), GGMLType.F32)
         if cfg.is_moe:
             put(f"{pre}.ffn_gate_inp.weight", layer("router").T, GGMLType.F32)
             put(f"{pre}.ffn_gate_exps.weight", layer("w_gate_e").transpose(0, 2, 1), quant)

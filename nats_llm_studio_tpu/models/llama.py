@@ -1,9 +1,11 @@
 """Llama-family transformer in pure functional JAX.
 
-One module covers the whole north-star zoo (BASELINE.md): Llama-3 (dense),
-Granite-3.x (dense + embedding/residual/attention/logit multipliers), and
-Mixtral (MoE FFN) — in GGUF all three differ only by metadata scales and the
-``expert_count`` key, not by topology.
+One module covers the whole north-star zoo (BASELINE.md) and beyond:
+Llama-3 (dense), Granite-3.x (dense + embedding/residual/attention/logit
+multipliers), Mixtral (MoE FFN), Qwen2 (QKV biases), and Gemma (GeGLU,
+(1+w) RMSNorm, scaled tied embeddings) — in GGUF these differ only by
+metadata scales and a handful of family flags (models.config), not by
+topology.
 
 TPU-first structure: all per-layer weights carry a leading ``[L]`` axis and
 the layer stack runs as a single ``lax.scan`` — one compiled block regardless
@@ -57,9 +59,16 @@ def _attention_block(
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     s_max = k_all.shape[3]
-    q = mm(x, p["wq"]).reshape(b, t, hq, d)
-    k = mm(x, p["wk"]).reshape(b, t, hkv, d)
-    v = mm(x, p["wv"]).reshape(b, t, hkv, d)
+    q = mm(x, p["wq"])
+    k = mm(x, p["wk"])
+    v = mm(x, p["wv"])
+    if cfg.attn_bias:  # qwen2-family QKV biases
+        q = q + p["bq"]
+        k = k + p["bk"]
+        v = v + p["bv"]
+    q = q.reshape(b, t, hq, d)
+    k = k.reshape(b, t, hkv, d)
+    v = v.reshape(b, t, hkv, d)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -245,12 +254,13 @@ def forward(
 
     def block_body(x, k_all, v_all, p, layer, allow_flash=True):
         attn_out, k_all, v_all = _attention_block(
-            rms_norm(x, p["attn_norm"], cfg.rms_eps), p, cfg, k_all, v_all, layer,
+            rms_norm(x, p["attn_norm"], cfg.rms_eps, cfg.norm_plus_one),
+            p, cfg, k_all, v_all, layer,
             start_pos, cos, sin, mask, attn_window, allow_flash,
             ring_slot if t == 1 else None, mesh,
         )
         x = x + attn_out * cfg.residual_scale
-        h = rms_norm(x, p["ffn_norm"], cfg.rms_eps)
+        h = rms_norm(x, p["ffn_norm"], cfg.rms_eps, cfg.norm_plus_one)
         if cfg.is_moe:
             if cfg.use_routed_moe:
                 from ..parallel.moe import routed_moe_ffn
@@ -259,7 +269,7 @@ def forward(
             else:
                 ffn_out = _moe_ffn(h, p, cfg)
         else:
-            ffn_out = swiglu(h, p["w_gate"], p["w_up"], p["w_down"])
+            ffn_out = swiglu(h, p["w_gate"], p["w_up"], p["w_down"], cfg.mlp_act)
         x = x + ffn_out * cfg.residual_scale
         return x, k_all, v_all
 
@@ -282,7 +292,7 @@ def forward(
             block, (x, k_cache, v_cache), (params["blocks"], layer_idx)
         )
 
-    x = rms_norm(x, params["out_norm"], cfg.rms_eps)
+    x = rms_norm(x, params["out_norm"], cfg.rms_eps, cfg.norm_plus_one)
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
@@ -348,6 +358,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "wv": rand(L, d, hkv * hd),
         "wo": rand(L, hq * hd, d),
     }
+    if cfg.attn_bias:
+        blocks |= {
+            "bq": rand(L, hq * hd),
+            "bk": rand(L, hkv * hd),
+            "bv": rand(L, hkv * hd),
+        }
     if cfg.is_moe:
         e = cfg.n_experts
         blocks |= {
@@ -416,6 +432,14 @@ def load_params_from_gguf(reader, cfg: ModelConfig, dtype: str | None = None) ->
         push("wk", jnp.asarray(_rope_deinterleave(wk, cfg.n_kv_heads, cfg.head_dim), dt))
         push("wv", mat(f"{pre}.attn_v.weight"))
         push("wo", mat(f"{pre}.attn_output.weight"))
+        if cfg.attn_bias:
+            # biases live in the same output-feature space as the weights,
+            # so q/k biases need the same rope pair permutation
+            push("bq", jnp.asarray(_rope_deinterleave(
+                t(f"{pre}.attn_q.bias")[None], cfg.n_heads, cfg.head_dim)[0], dt))
+            push("bk", jnp.asarray(_rope_deinterleave(
+                t(f"{pre}.attn_k.bias")[None], cfg.n_kv_heads, cfg.head_dim)[0], dt))
+            push("bv", jnp.asarray(t(f"{pre}.attn_v.bias"), dt))
         if cfg.is_moe:
             push("router", mat(f"{pre}.ffn_gate_inp.weight"))
             # stacked expert tensors: reader shape (E, ff, d) -> [E, d, ff]

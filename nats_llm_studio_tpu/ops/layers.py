@@ -11,11 +11,17 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
-    """Root-mean-square layer norm (no mean subtraction, no bias)."""
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float = 1e-5, plus_one: bool = False
+) -> jax.Array:
+    """Root-mean-square layer norm (no mean subtraction, no bias).
+
+    ``plus_one`` applies gemma's ``x * (1 + w)`` convention (the GGUF stores
+    w, not 1+w — matching llama.cpp's build_gemma)."""
     xf = x.astype(jnp.float32)
     rrms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (xf * rrms).astype(x.dtype) * weight
+    y = (xf * rrms).astype(x.dtype)
+    return y * (weight + 1) if plus_one else y * weight
 
 
 def rope_cos_sin(
@@ -94,13 +100,16 @@ def gqa_attention_hmajor(
     return out.reshape(b, t, hq, d)
 
 
-def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
-    """SwiGLU MLP: down( silu(x @ gate) * (x @ up) ).
+def swiglu(x: jax.Array, w_gate, w_up, w_down, act: str = "silu") -> jax.Array:
+    """Gated MLP: down( act(x @ gate) * (x @ up) ).
 
+    ``act`` selects the gate nonlinearity — "silu" (llama/granite/mixtral/
+    qwen2 SwiGLU) or "gelu" (gemma GeGLU, tanh approximation as ggml uses).
     Weights are [d_in, d_out] row-major (plain ``x @ w``), stored bf16 or
     weight-only int8 (ops.wquant.QTensor).
     """
     from .wquant import mm
 
-    gate = jax.nn.silu(mm(x, w_gate))
+    g = mm(x, w_gate)
+    gate = jax.nn.silu(g) if act == "silu" else jax.nn.gelu(g, approximate=True)
     return mm(gate * mm(x, w_up), w_down)

@@ -107,6 +107,12 @@ def load_params_sharded(
         push("wk", _rope_deinterleave(mat(f"{pre}.attn_k.weight"), cfg.n_kv_heads, cfg.head_dim))
         push("wv", mat(f"{pre}.attn_v.weight"))
         push("wo", mat(f"{pre}.attn_output.weight"))
+        if cfg.attn_bias:
+            push("bq", _rope_deinterleave(
+                t(f"{pre}.attn_q.bias")[None], cfg.n_heads, cfg.head_dim)[0])
+            push("bk", _rope_deinterleave(
+                t(f"{pre}.attn_k.bias")[None], cfg.n_kv_heads, cfg.head_dim)[0])
+            push("bv", t(f"{pre}.attn_v.bias"))
         if cfg.is_moe:
             push("router", mat(f"{pre}.ffn_gate_inp.weight"))
             push("w_gate_e", t(f"{pre}.ffn_gate_exps.weight").transpose(0, 2, 1))

@@ -38,6 +38,12 @@ def _leaves(cfg: ModelConfig, dtype_bytes: int) -> dict[str, _Leaf]:
         "blocks.wv": _Leaf((L, d, hkv * hd), (2,), dtype_bytes, True),
         "blocks.wo": _Leaf((L, hq * hd, d), (1,), dtype_bytes, True),
     }
+    if cfg.attn_bias:
+        out |= {
+            "blocks.bq": _Leaf((L, hq * hd), (1,), dtype_bytes),
+            "blocks.bk": _Leaf((L, hkv * hd), (1,), dtype_bytes),
+            "blocks.bv": _Leaf((L, hkv * hd), (1,), dtype_bytes),
+        }
     if cfg.is_moe:
         e = cfg.n_experts
         out |= {

@@ -43,6 +43,9 @@ def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
         "blocks.wk": P(None, None, tp),
         "blocks.wv": P(None, None, tp),
         "blocks.wo": P(None, tp, None),
+        "blocks.bq": P(None, tp),  # qwen2 QKV biases: output-feature sharded
+        "blocks.bk": P(None, tp),
+        "blocks.bv": P(None, tp),
         "blocks.w_gate": P(None, None, tp),
         "blocks.w_up": P(None, None, tp),
         "blocks.w_down": P(None, tp, None),

@@ -335,3 +335,99 @@ def test_generator_stats(tiny):
     assert stats.prompt_tokens == 4
     assert stats.completion_tokens >= 1
     assert stats.ttft_s > 0
+
+
+def test_qwen2_bias_forward_and_roundtrip(tmp_path):
+    """Qwen2-family: QKV biases change the logits, survive prefill/decode
+    consistency, and round-trip through GGUF (including the rope pair
+    permutation applied to q/k biases)."""
+    cfg = ModelConfig.tiny(arch="qwen2", n_layers=2, attn_bias=True)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    assert "bq" in params["blocks"]
+    tokens = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    k, v = make_cache(cfg, 1, 16)
+    with_bias, k, v = forward(params, cfg, tokens, k, v, jnp.zeros((1,), jnp.int32))
+    # decode step must match the full 5-token prefill at the same position
+    # (pins the bias path through t==1 decode, not just prefill)
+    nxt, _, _ = forward(
+        params, cfg, jnp.asarray([[9]], jnp.int32), k, v, jnp.full((1,), 4, jnp.int32)
+    )
+    k5, v5 = make_cache(cfg, 1, 16)
+    full5, _, _ = forward(
+        params, cfg, jnp.asarray([[5, 6, 7, 8, 9]], jnp.int32), k5, v5,
+        jnp.zeros((1,), jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(nxt[0, -1]), np.asarray(full5[0, -1]), rtol=2e-4, atol=2e-4
+    )
+    zeroed = dict(params)
+    zeroed["blocks"] = dict(params["blocks"])
+    for bk_ in ("bq", "bk", "bv"):
+        zeroed["blocks"][bk_] = jnp.zeros_like(params["blocks"][bk_])
+    k0, v0 = make_cache(cfg, 1, 16)
+    no_bias, _, _ = forward(zeroed, cfg, tokens, k0, v0, jnp.zeros((1,), jnp.int32))
+    assert not np.allclose(np.asarray(with_bias), np.asarray(no_bias))
+
+    path = tmp_path / "qwen2.gguf"
+    export_params_to_gguf(path, params, cfg, name="tiny-qwen2")
+    with GGUFReader(path) as r:
+        cfg2 = ModelConfig.from_gguf_metadata(r.metadata).with_(dtype="float32")
+        assert cfg2.attn_bias  # derived from the architecture name
+        params2 = load_params_from_gguf(r, cfg2)
+    k2, v2 = make_cache(cfg2, 1, 16)
+    again, _, _ = forward(params2, cfg2, tokens, k2, v2, jnp.zeros((1,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(again), np.asarray(with_bias), rtol=1e-5, atol=1e-5)
+
+
+def test_gemma_family_forward_and_roundtrip(tmp_path):
+    """Gemma-family: GELU MLP, tied embeddings with
+    sqrt(d_model) embedding scaling — all derived from the arch name and
+    consistent through prefill/decode and the GGUF round-trip."""
+    cfg = ModelConfig.tiny(
+        arch="gemma", n_layers=2, mlp_act="gelu",
+        tie_embeddings=True, embedding_scale=8.0,  # sqrt(64)
+    )
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    assert "lm_head" not in params  # tied
+    seq = [3, 14, 15, 9, 2, 6]
+    k, v = make_cache(cfg, 1, 16)
+    full, _, _ = forward(
+        params, cfg, jnp.asarray([seq], jnp.int32), k, v, jnp.zeros((1,), jnp.int32)
+    )
+    # token-by-token decode reproduces the full prefill logits
+    k, v = make_cache(cfg, 1, 16)
+    _, k, v = forward(
+        params, cfg, jnp.asarray([seq[:3]], jnp.int32), k, v, jnp.zeros((1,), jnp.int32)
+    )
+    outs = []
+    for i, t in enumerate(seq[3:]):
+        o, k, v = forward(
+            params, cfg, jnp.asarray([[t]], jnp.int32), k, v,
+            jnp.full((1,), 3 + i, jnp.int32),
+        )
+        outs.append(np.asarray(o[0, -1]))
+    np.testing.assert_allclose(outs[-1], np.asarray(full[0, -1]), rtol=2e-4, atol=2e-4)
+
+    path = tmp_path / "gemma.gguf"
+    export_params_to_gguf(path, params, cfg, name="tiny-gemma")
+    with GGUFReader(path) as r:
+        cfg2 = ModelConfig.from_gguf_metadata(r.metadata).with_(dtype="float32")
+        assert cfg2.mlp_act == "gelu" and not cfg2.norm_plus_one
+        # (GGUF stores gemma norms with the +1 already folded in)
+        assert cfg2.embedding_scale == 8.0
+        params2 = load_params_from_gguf(r, cfg2)
+    k2, v2 = make_cache(cfg2, 1, 16)
+    again, _, _ = forward(
+        params2, cfg2, jnp.asarray([seq], jnp.int32), k2, v2, jnp.zeros((1,), jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(again), np.asarray(full), rtol=1e-5, atol=1e-5)
+
+
+def test_unsupported_archs_rejected():
+    """Architectures whose topology the model does not implement must fail
+    loudly at config time, not half-run to garbage logits."""
+    for arch in ("gemma2", "qwen2moe"):
+        md = {"general.architecture": arch, f"{arch}.block_count": 2,
+              f"{arch}.embedding_length": 64, f"{arch}.attention.head_count": 4}
+        with pytest.raises(NotImplementedError):
+            ModelConfig.from_gguf_metadata(md)
