@@ -90,9 +90,15 @@ class _ClientConn:
                     break
                 for ev in self.parser.feed(data):
                     await self._handle(ev)
-        except (ConnectionError, OSError, p.ProtocolError) as e:
-            if isinstance(e, p.ProtocolError):
-                self.send(p.encode_err(str(e)))
+        except (ConnectionError, OSError, p.ProtocolError, ValueError) as e:
+            # ValueError covers malformed CONNECT JSON (json.JSONDecodeError)
+            # and non-numeric size fields — a hostile or broken peer must get
+            # -ERR + drop, never an unhandled task exception (SURVEY.md §5
+            # failure detection; found by the protocol fuzz test)
+            if isinstance(e, (p.ProtocolError, ValueError)) and not isinstance(
+                e, (ConnectionError, OSError)
+            ):
+                self.send(p.encode_err(f"protocol violation: {e}"))
         finally:
             await self._close()
 

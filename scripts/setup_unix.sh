@@ -32,6 +32,8 @@ MODEL_BUCKET=llm-models
 MAX_BATCH_SLOTS=8
 MAX_SEQ_LEN=4096
 # TPU_MESH=tp=8            # uncomment to pin a mesh layout
+# TPU_QUANT=int8           # weight-only int8 (fits 70B on v5e-8)
+# URL_PULL_SCHEMES=https   # schemes pull_model may fetch directly
 # JAX_COORDINATOR_ADDRESS= # host:port for multi-host meshes
 EOF
 echo "    wrote .env"

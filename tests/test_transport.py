@@ -231,3 +231,61 @@ async def test_request_stream_terminal_header():
         await server.close()
     finally:
         await broker.stop()
+
+
+@async_test
+async def test_broker_survives_protocol_fuzz():
+    """Random garbage byte streams must never crash the broker: every
+    connection gets -ERR or a drop, and well-formed clients keep working
+    throughout (SURVEY.md §5 failure detection)."""
+    import random as _random
+
+    broker = await _broker()
+    try:
+        nc = await connect(broker.url)
+        sub = await nc.subscribe("alive")
+        await nc.flush()
+        rnd = _random.Random(7)
+        for i in range(24):
+            r, w = await asyncio.open_connection("127.0.0.1", broker.port)
+            await r.readline()  # INFO
+            if i % 3 == 0:
+                blob = bytes(rnd.randrange(256) for _ in range(rnd.randrange(1, 400)))
+            elif i % 3 == 1:
+                blob = b"PUB  \r\nxx\r\nSUB\r\nHPUB a 999999999\r\n"
+            else:
+                blob = ("\r\n".join(
+                    rnd.choice(["PING", "PONG", "CONNECT {", "MSG x 1 5", "UNSUB",
+                                "PUB a b c d e", "SUB " + "s" * 300 + " 1"])
+                    for _ in range(8)) + "\r\n").encode()
+            try:
+                w.write(blob)
+                await w.drain()
+                got = b""
+                try:
+                    while len(got) < 4096:
+                        chunk = await asyncio.wait_for(r.read(1024), timeout=0.5)
+                        if not chunk:
+                            break
+                        got += chunk
+                except asyncio.TimeoutError:
+                    pass
+                # for inputs containing complete invalid frames the broker
+                # must reply (-ERR, or PONG for the interleaved PINGs) or
+                # drop the connection — never silently buffer them. Pure
+                # random bytes may legitimately sit as an incomplete frame.
+                if i % 3 != 0:
+                    dropped = r.at_eof()
+                    responded = (b"-ERR" in got) or (b"PONG" in got) or dropped
+                    assert responded, (i, blob[:40], got[:80])
+            except (ConnectionError, OSError):
+                pass  # dropped mid-write: acceptable rejection
+            finally:
+                w.close()
+        # the broker still routes for well-formed clients
+        await nc.publish("alive", b"yes")
+        msg = await sub.next_msg(timeout=5)
+        assert msg.payload == b"yes"
+        await nc.close()
+    finally:
+        await broker.stop()
