@@ -14,7 +14,7 @@ no reference code exists for it.
 
 from .constants import GGMLType, GGUFValueType
 from .quants import dequantize, quantize, type_block_size, type_size
-from .reader import GGUFReader, GGUFTensor
+from .reader import GGUFReader, GGUFShardedReader, GGUFTensor, open_gguf
 from .tokenizer import GGUFTokenizer
 from .writer import GGUFWriter
 
@@ -22,6 +22,8 @@ __all__ = [
     "GGMLType",
     "GGUFValueType",
     "GGUFReader",
+    "GGUFShardedReader",
+    "open_gguf",
     "GGUFTensor",
     "GGUFTokenizer",
     "GGUFWriter",

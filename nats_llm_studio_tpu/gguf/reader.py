@@ -9,6 +9,7 @@ model in host RAM (SURVEY.md §7 hard part #2).
 from __future__ import annotations
 
 import mmap
+import re
 import struct
 from dataclasses import dataclass
 from pathlib import Path
@@ -205,3 +206,105 @@ class GGUFReader:
     def arch_field(self, field: str, default: Any = None) -> Any:
         """Read ``<architecture>.<field>`` from metadata."""
         return self.metadata.get(f"{self.architecture}.{field}", default)
+
+
+class GGUFShardedReader:
+    """Reader over a split GGUF (llama.cpp `gguf-split` layout): shards named
+    ``<base>-NNNNN-of-MMMMM.gguf``, each a complete GGUF holding a subset of
+    the tensors, with ``split.no`` / ``split.count`` / ``split.tensors.count``
+    metadata. 70B-class public checkpoints ship this way (single files cap
+    around 48 GB on common hosts), so the serving loaders accept either form.
+
+    Presents the same surface the loaders use: merged ``.tensors``,
+    ``.metadata`` (from shard 1, which carries the full model metadata), and
+    per-tensor dispatch to the owning shard's mapping.
+    """
+
+    def __init__(self, paths: "list[str | Path]"):
+        if not paths:
+            raise ValueError("no shard paths given")
+        self.shards: list[GGUFReader] = []
+        try:
+            for p in sorted(Path(p) for p in paths):
+                self.shards.append(GGUFReader(p))
+            count = int(self.shards[0].metadata.get("split.count", len(self.shards)))
+            if count != len(self.shards):
+                raise ValueError(
+                    f"split.count={count} but {len(self.shards)} shard files found"
+                )
+            first_no = int(self.shards[0].metadata.get("split.no", 0))
+            if first_no != 0:
+                raise ValueError(
+                    "first shard (lexicographically) has split.no="
+                    f"{first_no}; shard names must order the set"
+                )
+            self.path = self.shards[0].path
+            self.metadata = self.shards[0].metadata
+            self.tensors: dict[str, GGUFTensor] = {}
+            for shard in self.shards:
+                for name, tns in shard.tensors.items():
+                    if name in self.tensors:
+                        raise ValueError(f"tensor {name!r} appears in two shards")
+                    self.tensors[name] = tns
+        except Exception:
+            self.close()
+            raise
+
+    def tensor(self, name: str) -> GGUFTensor:
+        try:
+            return self.tensors[name]
+        except KeyError:
+            raise KeyError(f"tensor {name!r} not in {self.path.name} shards") from None
+
+    @property
+    def architecture(self) -> str:
+        return str(self.metadata.get("general.architecture", ""))
+
+    def arch_field(self, field: str, default=None):
+        return self.metadata.get(f"{self.architecture}.{field}", default)
+
+    def close(self) -> None:
+        for shard in getattr(self, "shards", []):
+            shard.close()
+
+    def __enter__(self) -> "GGUFShardedReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_SPLIT_RE = re.compile(r"^(.*)-(\d{5})-of-(\d{5})\.gguf$")
+
+
+def is_split_shard(path: "str | Path") -> bool:
+    """Whether a filename follows the gguf-split shard convention."""
+    return _SPLIT_RE.match(Path(path).name) is not None
+
+
+def open_gguf(path_or_paths):
+    """Open a GGUF model file OR a split set.
+
+    Accepts a single path (auto-detecting ``-NNNNN-of-MMMMM.gguf`` siblings),
+    or an explicit list of shard paths. Returns a GGUFReader or
+    GGUFShardedReader with the same read surface. A single path naming a
+    shard requires every sibling to exist (partial downloads fail loudly).
+    """
+    if isinstance(path_or_paths, (list, tuple)):
+        paths = [Path(p) for p in path_or_paths]
+        if len(paths) == 1 and is_split_shard(paths[0]):
+            return open_gguf(paths[0])  # enforce sibling discovery
+        return GGUFShardedReader(paths) if len(paths) > 1 else GGUFReader(paths[0])
+    path = Path(path_or_paths)
+    m = _SPLIT_RE.match(path.name)
+    if m:
+        base, total = m.group(1), int(m.group(3))
+        siblings = [
+            path.with_name(f"{base}-{i + 1:05d}-of-{total:05d}.gguf")
+            for i in range(total)
+        ]
+        missing = [p.name for p in siblings if not p.exists()]
+        if missing:
+            raise FileNotFoundError(f"missing GGUF shards: {missing}")
+        return GGUFShardedReader(siblings)
+    return GGUFReader(path)

@@ -16,7 +16,7 @@ from typing import Any, AsyncIterator
 import jax
 
 from ..engine.generator import GenStats, SamplingParams
-from ..gguf.reader import GGUFReader
+from ..gguf.reader import open_gguf
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
@@ -266,13 +266,22 @@ class LocalRegistry(Registry):
             cm = self.store.lookup(model_id)
             if cm is None:
                 raise ModelNotFound(model_id)
-            eng = await asyncio.to_thread(self._load, cm.model_id, str(cm.gguf_path))
+            eng = await asyncio.to_thread(
+                self._load, cm.model_id, [str(f) for f in cm.files]
+            )
             self._engines[cm.model_id] = eng
             return eng
 
-    def _load(self, model_id: str, path: str) -> JaxChatEngine:
+    def _load(self, model_id: str, paths: list[str]) -> JaxChatEngine:
         t0 = time.perf_counter()
-        reader = GGUFReader(path)
+        from ..gguf.reader import is_split_shard
+
+        split = sorted(p for p in paths if is_split_shard(p))
+        # a -NNNNN-of-MMMMM split set loads as one model (open_gguf verifies
+        # every sibling exists, so a partial download fails loudly instead of
+        # serving a third of the weights); otherwise keep the long-standing
+        # behavior of serving the first .gguf in the dir
+        reader = open_gguf(split[0] if split else paths[0])
         cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(
             dtype=self.dtype,
             use_flash_attention=jax.default_backend() == "tpu",  # prefill TTFT
