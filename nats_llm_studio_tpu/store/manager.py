@@ -17,6 +17,7 @@ import shutil
 from dataclasses import dataclass
 from pathlib import Path
 
+from ..gguf.reader import _SPLIT_RE
 from ..transport.jetstream import ObjectNotFound, ObjectStore
 from ..utils.nuid import next_nuid
 
@@ -26,6 +27,40 @@ def _tmp_part(dest_dir: Path, fname: str) -> Path:
     """Unique temp path per pull: concurrent pulls of the same target must
     not interleave writes into a shared .part file."""
     return dest_dir / f".{fname}.{os.getpid()}.{next_nuid()[:8]}.part"
+
+
+def _shard_family(files: "list[Path]") -> "list[Path]":
+    """The files that form ONE model: files[0]'s gguf-split family (every
+    shard with the same base and total), or just files[0] for a plain file.
+    Keeps publish from shipping unrelated .gguf files that share a dir."""
+    first = files[0]
+    m = _SPLIT_RE.match(first.name)
+    if not m:
+        return [first]
+    base, total = m.group(1), m.group(3)
+    fam = [
+        f for f in files
+        if (fm := _SPLIT_RE.match(f.name)) and fm.group(1) == base and fm.group(3) == total
+    ]
+    return sorted(fam)
+
+
+def _check_split_complete(names: "list[str]") -> None:
+    """Every split-named object's family must be complete — pulling a
+    partial shard set would cache a model that cannot load."""
+    have = set(names)
+    for nm in names:
+        m = _SPLIT_RE.match(Path(nm).name)
+        if not m:
+            continue
+        base, total = m.group(1), int(m.group(3))
+        prefix = nm.rsplit("/", 1)[0]
+        for i in range(total):
+            want = f"{prefix}/{base}-{i + 1:05d}-of-{total:05d}.gguf"
+            if want not in have:
+                raise StoreError(
+                    f"incomplete split set in bucket: missing {want!r}"
+                )
 
 class StoreError(Exception):
     def __init__(self, msg: str, dir: str | None = None):
@@ -141,20 +176,26 @@ class ModelStore:
 
     async def publish_model(self, model_id: str, gguf_path: str | Path | None = None) -> str:
         """Upload a cached model (or explicit file) to the bucket as
-        ``<publisher>/<model>/<file>.gguf``. Returns the object name."""
+        ``<publisher>/<model>/<file>.gguf``. A model cached as a gguf-split
+        shard set uploads EVERY shard (a worker pulling the model needs the
+        complete set to load it). Returns the first object name."""
         store = self._require_store()
         if gguf_path is None:
             cm = self.lookup(model_id)
             if cm is None:
                 raise StoreError(f"model {model_id!r} not in local cache")
-            gguf_path = cm.gguf_path
-        gguf_path = Path(gguf_path)
+            paths = _shard_family(cm.files)
+        else:
+            paths = [Path(gguf_path)]
         pub, name = split_model_id(model_id)
-        obj_name = f"{pub}/{name}/{gguf_path.name}"
         await store.ensure_bucket(self.bucket)
-        data = await asyncio.to_thread(gguf_path.read_bytes)  # keep the loop serving
-        await store.put(self.bucket, obj_name, data)
-        return obj_name
+        obj_names = []
+        for p in paths:
+            obj_name = f"{pub}/{name}/{p.name}"
+            data = await asyncio.to_thread(p.read_bytes)  # keep the loop serving
+            await store.put(self.bucket, obj_name, data)
+            obj_names.append(obj_name)
+        return obj_names[0]
 
     async def pull(self, identifier: str, model_id: str | None = None) -> tuple[Path, str]:
         """Fetch a model from the bucket into the local cache (the `lms get`
@@ -176,13 +217,42 @@ class ModelStore:
         lines = [f"pulling {identifier!r} from bucket {self.bucket!r}"]
         obj_name = identifier.strip().strip("/")
         if not obj_name.endswith(".gguf"):
-            # model id: find the first object under that prefix
+            # model id: pull EVERY object under the prefix (a split model is
+            # several shard objects; one shard alone cannot be loaded)
             objs = await store.list(self.bucket)
-            matches = [o for o in objs if o.name.startswith(obj_name + "/")]
+            matches = sorted(
+                o.name for o in objs if o.name.startswith(obj_name + "/")
+            )
             if not matches:
                 raise StoreError(f"no objects under {obj_name!r} in bucket {self.bucket!r}")
-            obj_name = matches[0].name
-            lines.append(f"resolved to object {obj_name!r}")
+            _check_split_complete(matches)
+            lines.append(f"resolved to {len(matches)} object(s)")
+            # stage every shard, commit only when the whole set landed —
+            # the single-file temp/rename atomicity must hold for the SET
+            # (a partial set would look cached but fail to load)
+            staged: list[tuple[Path, Path, int]] = []
+            try:
+                for nm in matches:
+                    staged.append(await self._pull_object(nm, model_id))
+            except BaseException:
+                for _, tmp, _ in staged:
+                    tmp.unlink(missing_ok=True)
+                raise
+            for dest, tmp, total in staged:
+                tmp.replace(dest)
+                lines.append(f"wrote {total} bytes to {dest}")
+            return staged[0][0], "\n".join(lines)
+        dest, tmp, total = await self._pull_object(obj_name, model_id)
+        tmp.replace(dest)
+        lines.append(f"wrote {total} bytes to {dest}")
+        return dest, "\n".join(lines)
+
+    async def _pull_object(
+        self, obj_name: str, model_id: str | None
+    ) -> tuple[Path, Path, int]:
+        """Stream one bucket object to a staging file; returns
+        (dest, tmp, bytes) — the caller commits with tmp.replace(dest)."""
+        store = self._require_store()
         parts = obj_name.split("/")
         if len(parts) < 3:
             raise StoreError(
@@ -216,9 +286,7 @@ class ModelStore:
         except BaseException:
             tmp.unlink(missing_ok=True)
             raise
-        tmp.replace(dest)
-        lines.append(f"wrote {total} bytes to {dest}")
-        return dest, "\n".join(lines)
+        return dest, tmp, total
 
     async def _pull_url(self, url: str, model_id: str | None) -> tuple[Path, str]:
         """Stream a GGUF from an HTTP(S)/file URL into the local cache —

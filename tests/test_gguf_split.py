@@ -136,3 +136,79 @@ def test_registry_loads_split_model(tmp_path):
     import asyncio
 
     asyncio.run(drive())
+
+
+def test_publish_and_pull_split_set(tmp_path):
+    """publish_model uploads every shard; pulling by model id fetches the
+    whole set, so the destination cache can actually load the model."""
+    import asyncio
+
+    from nats_llm_studio_tpu.store import JetStreamStoreModule, ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+    from nats_llm_studio_tpu.transport.jetstream import ObjectStore
+
+    cfg = ModelConfig.tiny(n_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    _, paths = _make_split(src_dir, cfg, params)
+
+    async def drive():
+        broker = await EmbeddedBroker().start()
+        JetStreamStoreModule(broker).install()
+        nc = await connect(broker.url)
+        objstore = ObjectStore(nc, timeout=5.0)
+        ms_a = ModelStore(tmp_path / "worker_a", objstore=objstore)
+        adir = ms_a.model_dir("acme/big")
+        adir.mkdir(parents=True)
+        for p in paths:
+            (adir / p.name).write_bytes(p.read_bytes())
+        await ms_a.publish_model("acme/big")
+        ms_b = ModelStore(tmp_path / "worker_b", objstore=objstore)
+        dest, transcript = await ms_b.pull("acme/big")
+        got = sorted(f.name for f in ms_b.lookup("acme/big").files)
+        assert got == sorted(p.name for p in paths), transcript
+        # and the pulled set loads as one model
+        with open_gguf(str(ms_b.model_dir("acme/big") / paths[0].name)) as r:
+            assert isinstance(r, GGUFShardedReader)
+        await nc.close()
+        await broker.stop()
+
+    asyncio.run(drive())
+
+
+def test_pull_incomplete_split_set_fails_loudly(tmp_path):
+    """A bucket holding only part of a shard set must fail the pull (and
+    leave nothing committed in the cache) rather than cache an unloadable
+    model."""
+    import asyncio
+
+    from nats_llm_studio_tpu.store import JetStreamStoreModule, ModelStore
+    from nats_llm_studio_tpu.store.manager import StoreError
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+    from nats_llm_studio_tpu.transport.jetstream import ObjectStore
+
+    cfg = ModelConfig.tiny(n_layers=2)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    src_dir = tmp_path / "src"
+    src_dir.mkdir()
+    _, paths = _make_split(src_dir, cfg, params)
+
+    async def drive():
+        broker = await EmbeddedBroker().start()
+        JetStreamStoreModule(broker).install()
+        nc = await connect(broker.url)
+        objstore = ObjectStore(nc, timeout=5.0)
+        await objstore.ensure_bucket("llm-models")
+        # only shard 1 of 2 makes it to the bucket
+        await objstore.put(
+            "llm-models", f"acme/big/{paths[0].name}", paths[0].read_bytes()
+        )
+        ms = ModelStore(tmp_path / "worker", objstore=objstore)
+        with pytest.raises(StoreError, match="incomplete split set"):
+            await ms.pull("acme/big")
+        assert ms.lookup("acme/big") is None  # nothing committed
+        await nc.close()
+        await broker.stop()
+
+    asyncio.run(drive())

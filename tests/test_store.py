@@ -195,7 +195,7 @@ async def test_publish_and_pull_roundtrip(tmp_path):
         # second worker pulls by model id
         path, transcript = await ms_b.pull("acme/granite-tiny")
         assert path.read_bytes() == src.read_bytes()
-        assert "resolved to object" in transcript
+        assert "resolved to 1 object(s)" in transcript
         assert ms_b.lookup("acme/granite-tiny") is not None
         # and by full object name
         path2, _ = await ms_b.pull("acme/granite-tiny/model.gguf")
