@@ -9,8 +9,9 @@ topology.
 
 TPU-first structure: all per-layer weights carry a leading ``[L]`` axis and
 the layer stack runs as a single ``lax.scan`` — one compiled block regardless
-of depth, with the KV cache threaded through as scan xs/ys. No Python loops,
-no dynamic shapes under jit.
+of depth, with the full KV cache riding the scan as carry (in-place updates;
+see _attention_block for the measured design rationale). No Python loops, no
+dynamic shapes under jit.
 """
 
 from __future__ import annotations
