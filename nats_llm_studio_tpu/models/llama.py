@@ -75,18 +75,23 @@ def _attention_block(
 
     zero = jnp.zeros((), start_pos.dtype)
     win = attn_window if (attn_window is not None and attn_window < s_max) else s_max
+    is_ring_decode = t == 1 and ring_slot is not None
 
     def layer_slice(cache):
         if isinstance(layer, int):  # unrolled decode: static slice = view
             return cache[:, layer, :, :win]
         sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
                                    (b, 1, hkv, win, d))
-        if t == 1 and mesh is None and jax.default_backend() == "tpu":
-            # The attention dot wants the slice S-minor while the cache at
-            # rest is write-friendly D/B-minor; left alone, XLA materializes
-            # the slice AND relayout-copies it (~300 us/layer at batch 32 —
-            # half the decode step). Constraining the slice's layout merges
-            # both into one pass: 19.3 -> 15.6 ms/step (granite-2b b32).
+        if is_ring_decode and mesh is None and jax.default_backend() == "tpu":
+            # RING decode only: the attention dot wants the slice S-minor
+            # while the cache at rest is write-friendly D/B-minor; left
+            # alone, XLA materializes the slice AND relayout-copies it
+            # (~300 us/layer at batch 32 — half the decode step).
+            # Constraining the slice's layout merges both into one pass:
+            # 19.3 -> 15.6 ms/step (granite-2b b32). In the POSITIONAL path
+            # the per-row scatter pins a different cache layout and the same
+            # constraint backfires into full-cache relayouts (~16x slower —
+            # caught by scripts/ablate_decode.py).
             from jax.experimental.layout import Layout, with_layout_constraint
 
             sl = with_layout_constraint(
@@ -94,7 +99,7 @@ def _attention_block(
             )
         return sl[:, 0]
 
-    if t == 1 and ring_slot is not None:
+    if is_ring_decode:
         # Ring decode (the serving hot path): every row writes its fresh
         # k/v at the SAME shared slot, so the cache update is ONE
         # dynamic-update-slice spanning the batch — no per-row scatter
