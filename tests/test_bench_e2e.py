@@ -1,0 +1,24 @@
+"""The driver-visible bench's end-to-end NATS mode must keep working: it is
+the artifact that records TTFT/throughput each round. Smoke it at tiny scale
+on the CPU backend."""
+
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def test_e2e_nats_bench_smoke():
+    import bench
+    from nats_llm_studio_tpu.models.config import ModelConfig
+    from nats_llm_studio_tpu.models.llama import ensure_lm_head, init_params
+
+    cfg = ModelConfig.tiny(vocab_size=300, n_layers=2, max_seq_len=256)
+    params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
+    out = bench.e2e_nats_bench(cfg, params, n_concurrent=2, max_tokens=4)
+    assert set(out) >= {"ttft_p50_ms", "ttft_p95_ms", "e2e_tok_s", "clients"}
+    assert out["clients"] == 2
+    assert out["ttft_p50_ms"] > 0 and out["e2e_tok_s"] > 0
