@@ -46,6 +46,11 @@ class WorkerConfig:
     # by default on serving workers (bus clients must not be able to SSRF
     # through the worker or read its local files). Empty string disables.
     url_pull_schemes: str = field(default_factory=lambda: _env("URL_PULL_SCHEMES", "https"))
+    # ceiling on a single pull_model URL download (disk-fill guard); default
+    # mirrors the reference's 100 GiB JetStream file store (setup_unix.sh:93)
+    max_url_pull_bytes: int = field(
+        default_factory=lambda: int(_env("MAX_URL_PULL_BYTES", str(100 << 30)))
+    )
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

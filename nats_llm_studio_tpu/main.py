@@ -73,7 +73,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
     nc = await connect(cfg.nats_url, name="store-client")
     schemes = tuple(s for s in cfg.url_pull_schemes.split(",") if s)
     store = ModelStore(cfg.models_dir, objstore=ObjectStore(nc), bucket=cfg.bucket,
-                       url_schemes=schemes)
+                       url_schemes=schemes, max_url_pull_bytes=cfg.max_url_pull_bytes)
     registry = LocalRegistry(
         store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots,
         quant=cfg.quant_mode,

@@ -541,6 +541,29 @@ class ContinuousBatcher:
                     host_pos[s] = 0
             return True
 
+        def reset_after_failed_dispatch() -> None:
+            """A failed admit/decode dispatch may have consumed the donated
+            K/V buffers (e.g. device OOM raised after donation); continuing
+            would wedge every subsequent dispatch against invalidated
+            buffers (round-2 advisor). Fail the active streams honestly and
+            rebuild a fresh cache."""
+            nonlocal K, V, dirty
+            err = RuntimeError("batcher cache reset after a failed device dispatch")
+            for i, r in enumerate(self._slots):
+                if r is not None:
+                    r.emit("err", err)
+                    self._slots[i] = None
+                    host_tok[i] = 0
+                    host_pos[i] = 0
+            self._ring_next = 0
+            self._ring_wrapped = False
+            dirty = True
+            K, V = make_cache(cfg, B, self.max_seq)
+            if self.mesh is not None:
+                from ..parallel.sharding import shard_cache
+
+                K, V = shard_cache(K, V, self.mesh)
+
         waitlist: list[_Request] = []
         while True:
             act = active()
@@ -581,6 +604,7 @@ class ContinuousBatcher:
                     except Exception as e:  # noqa: BLE001 — surface to callers
                         for req in group:
                             req.emit("err", e)
+                        reset_after_failed_dispatch()
                         continue
                     if handled:
                         continue
@@ -590,7 +614,11 @@ class ContinuousBatcher:
                         admit_one(req)
                     except Exception as e:  # noqa: BLE001 — surface to the caller
                         req.emit("err", e)
-            decode_once()
+                        reset_after_failed_dispatch()
+            try:
+                decode_once()
+            except Exception:  # noqa: BLE001 — K/V were donated; must reset
+                reset_after_failed_dispatch()
 
     def _deliver(self, req: _Request, tok_id: int) -> bool:
         """Push one token; returns False when the request just finished."""

@@ -337,10 +337,15 @@ class Worker:
 
     async def on_profile(self, msg: Msg) -> None:
         """profile — capture a jax.profiler device trace for ``seconds``
-        (default 2) into ``dir`` (default under /tmp) and reply with the
-        trace path. The SURVEY.md §5 profiling endpoint: drive load through
+        (default 2) into a worker-chosen directory and reply with the trace
+        path. The SURVEY.md §5 profiling endpoint: drive load through
         chat_model while this runs, then inspect the trace with the
-        TensorBoard profile plugin."""
+        TensorBoard profile plugin.
+
+        The trace directory is always worker-chosen (mkdtemp): bus clients
+        are untrusted (see config.py threat model) and a client-supplied
+        path would be an arbitrary-directory-write primitive on the worker
+        host (round-2 advisor, medium)."""
         import tempfile
 
         import jax
@@ -361,7 +366,7 @@ class Worker:
             await self._respond_error(msg, "a profile capture is already running")
             return
         self._profiling = True
-        trace_dir = req.get("dir") or tempfile.mkdtemp(prefix="tpu_trace_")
+        trace_dir = tempfile.mkdtemp(prefix="tpu_trace_")
         try:
             jax.profiler.start_trace(trace_dir)
             try:

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import re
 import shutil
 from dataclasses import dataclass
 from pathlib import Path
@@ -85,11 +86,26 @@ class CachedModel:
         return sum(f.stat().st_size for f in self.files)
 
 
+# every path component a model id may contribute to the cache layout: must
+# start alphanumeric (excludes '.', '..', hidden files) and stay in a
+# conservative charset (excludes separators, NUL, '~', '%'-escapes resolving
+# later). Model ids are CLIENT-CONTROLLED (pull/delete/sync subjects), and
+# model_dir()/delete_local() turn them into mkdir/rmtree targets.
+_SAFE_COMPONENT = re.compile(r"[A-Za-z0-9][A-Za-z0-9._\- ]*\Z")
+
+
 def split_model_id(model_id: str) -> tuple[str, str]:
     """"publisher/model" -> (publisher, model); bare names get publisher
     "local" (mirrors the reference's fallback of deriving the publisher from
-    the id prefix, nats_llm_studio.go:112-118, without the duplication)."""
+    the id prefix, nats_llm_studio.go:112-118, without the duplication).
+
+    Every '/'-separated component is validated against a conservative
+    pattern: a hostile id like '../../../etc' must never become a
+    filesystem path (model_dir -> mkdir; delete_local -> rmtree)."""
     model_id = model_id.strip().strip("/")
+    for comp in model_id.split("/"):
+        if not _SAFE_COMPONENT.match(comp):
+            raise StoreError(f"unsafe model id component {comp!r} in {model_id!r}")
     if "/" in model_id:
         pub, _, name = model_id.partition("/")
         return pub, name
@@ -101,7 +117,8 @@ class ModelStore:
 
     def __init__(self, models_dir: str | Path, objstore: ObjectStore | None = None,
                  bucket: str = "llm-models",
-                 url_schemes: tuple[str, ...] = ("https", "http", "file")):
+                 url_schemes: tuple[str, ...] = ("https", "http", "file"),
+                 max_url_pull_bytes: int = 100 << 30):
         self.models_dir = Path(models_dir).expanduser()
         self.models_dir.mkdir(parents=True, exist_ok=True)
         self.objstore = objstore
@@ -111,6 +128,10 @@ class ModelStore:
         # shared-bus client must not be able to drive the worker to GET
         # internal endpoints or read local files into the served cache (SSRF)
         self.url_schemes = tuple(url_schemes)
+        # ceiling on a single URL pull: a hostile/huge URL must not fill the
+        # worker's disk (default matches the reference's 100 GiB JetStream
+        # file-store bound, setup_unix.sh:93)
+        self.max_url_pull_bytes = max_url_pull_bytes
 
     # -- local cache ---------------------------------------------------------
 
@@ -298,7 +319,13 @@ class ModelStore:
         fname = Path(urllib.parse.urlparse(url).path).name or "model.gguf"
         if not fname.endswith(".gguf"):
             raise StoreError(f"URL pull expects a .gguf file, got {fname!r}")
-        mid = model_id or f"downloads/{fname.removesuffix('.gguf')}"
+        # the URL basename becomes a path component of the cache layout: a
+        # stem like '..' or one with separators/odd bytes would escape the
+        # publisher/model directory scheme (round-2 advisor)
+        stem = fname.removesuffix(".gguf")
+        if not re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", stem) or ".." in stem:
+            raise StoreError(f"unsafe model filename in URL: {fname!r}")
+        mid = model_id or f"downloads/{stem}"
         dest_dir = self.model_dir(mid)
         dest_dir.mkdir(parents=True, exist_ok=True)
         dest = dest_dir / fname
@@ -320,16 +347,27 @@ class ModelStore:
 
         opener = urllib.request.build_opener(_SchemeGuardRedirect())
 
+        limit = self.max_url_pull_bytes
+
         def fetch() -> int:
             total = 0
             with opener.open(url, timeout=60.0) as r, open(tmp, "wb") as f:
                 expect = r.headers.get("Content-Length")
+                if expect is not None and int(expect) > limit:
+                    raise OSError(
+                        f"download of {expect} bytes exceeds the "
+                        f"{limit}-byte URL pull ceiling"
+                    )
                 while True:
                     chunk = r.read(1 << 20)
                     if not chunk:
                         break
                     f.write(chunk)
                     total += len(chunk)
+                    if total > limit:
+                        raise OSError(
+                            f"download exceeded the {limit}-byte URL pull ceiling"
+                        )
             # a premature close makes read() return b'' without an error —
             # verify against the advertised size before committing
             if expect is not None and total != int(expect):

@@ -88,6 +88,10 @@ class JetStreamStoreModule:
     def install(self) -> "JetStreamStoreModule":
         self.broker.register_internal(_API_PREFIX + ">", self._on_api)
         self.broker.register_internal("$O.>", self._on_capture)
+        # broker.stop() closes the append-log handles deterministically
+        # (round-2 advisor: GC-held "a+b" handles block dir removal on
+        # Windows and leak fds across test restarts)
+        self.broker.register_module(self)
         return self
 
     def close(self) -> None:

@@ -26,6 +26,9 @@ from . import protocol as p
 log = logging.getLogger(__name__)
 
 MAX_PAYLOAD = 1024 * 1024  # real nats-server's default; chunks are 128 KiB
+MAX_PENDING = 64 * 1024 * 1024  # per-client outbound buffer bound (nats-server
+# default max_pending): a stalled subscriber must not buffer without limit —
+# it is dropped with -ERR 'Slow Consumer' like the real server
 
 
 @dataclass(slots=True)
@@ -47,27 +50,48 @@ class _ClientConn:
         self.cid = broker._next_cid()
         self.closed = False
         self._out = asyncio.Queue[bytes | None]()
+        self._pending = 0  # bytes enqueued but not yet written to the socket
+        self._dropping = False  # slow-consumer drop already scheduled
         self._writer_task: asyncio.Task | None = None
 
     def send(self, data: bytes) -> None:
-        if not self.closed:
-            self._out.put_nowait(data)
+        if self.closed or self._dropping:
+            return
+        if self._pending + len(data) > self.broker.max_pending:
+            self._dropping = True
+            # slow consumer: the write loop is not draining (stalled reader).
+            # Bound broker memory by dropping the client, as nats-server does.
+            log.warning(
+                "client %d exceeded %d pending bytes; dropping (slow consumer)",
+                self.cid, self.broker.max_pending,
+            )
+            self._out.put_nowait(p.encode_err("Slow Consumer"))  # best-effort
+            asyncio.ensure_future(self._close())
+            return
+        self._pending += len(data)
+        self._out.put_nowait(data)
 
     async def _write_loop(self) -> None:
         try:
-            while True:
+            done = False
+            while not done:
                 data = await self._out.get()
                 if data is None:
                     break
-                # coalesce pending writes
+                # coalesce pending writes; a None pulled mid-coalesce is the
+                # shutdown sentinel — flush what we have, then exit (it must
+                # not be swallowed, or _close() stalls its full 1 s wait)
                 chunks = [data]
                 while not self._out.empty():
                     nxt = self._out.get_nowait()
                     if nxt is None:
+                        done = True
                         break
                     chunks.append(nxt)
-                self.writer.write(b"".join(chunks))
+                buf = b"".join(chunks)
+                self.writer.write(buf)
                 await self.writer.drain()
+                self._pending = max(0, self._pending - len(buf))
         except (ConnectionError, OSError, asyncio.CancelledError):
             pass
 
@@ -157,10 +181,12 @@ InternalHandler = Callable[[str, bytes, str | None, dict[str, str] | None], Awai
 class EmbeddedBroker:
     """In-process NATS-compatible broker. ``await start()`` binds the port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, max_payload: int = MAX_PAYLOAD):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, max_payload: int = MAX_PAYLOAD,
+                 max_pending: int = MAX_PENDING):
         self.host = host
         self.port = port
         self.max_payload = max_payload
+        self.max_pending = max_pending
         self.server_id = f"EMB{random.getrandbits(48):012X}"
         self._server: asyncio.base_events.Server | None = None
         self._clients: set[_ClientConn] = set()
@@ -168,6 +194,8 @@ class EmbeddedBroker:
         self._cid = 0
         # internal modules: (pattern, handler) — called in-process, no socket
         self._internal: list[tuple[str, InternalHandler]] = []
+        # modules with lifecycle (closed deterministically on stop())
+        self._modules: list = []
 
     @property
     def url(self) -> str:
@@ -188,6 +216,13 @@ class EmbeddedBroker:
             await self._server.wait_closed()
         for c in list(self._clients):
             await c._close()
+        # close registered modules (e.g. the object store's append-log file
+        # handles) deterministically instead of leaving them to GC
+        for m in self._modules:
+            close = getattr(m, "close", None)
+            if close is not None:
+                close()
+        self._modules.clear()
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         conn = _ClientConn(self, reader, writer)
@@ -208,6 +243,10 @@ class EmbeddedBroker:
     def register_internal(self, pattern: str, handler: InternalHandler) -> None:
         """Register a server-side module handler (object store, health...)."""
         self._internal.append((pattern, handler))
+
+    def register_module(self, module) -> None:
+        """Track a module for lifecycle: its ``close()`` runs on ``stop()``."""
+        self._modules.append(module)
 
     # -- routing -------------------------------------------------------------
 

@@ -1,0 +1,194 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md):
+
+1. the `lmstudio.profile` subject must ignore a client-supplied 'dir'
+   (covered in test_worker.py::test_profile_subject);
+2. a failed admit/decode dispatch may have consumed donated K/V buffers —
+   the batcher must reset its cache (failing active streams honestly)
+   instead of wedging every subsequent dispatch;
+3. `_pull_url` must reject unsafe URL basenames and enforce a download
+   size ceiling;
+4. the broker must bound a slow consumer's outbound buffer and drop the
+   client, like real nats-server;
+5. `broker.stop()` must close the object-store module's append-log handles
+   deterministically (no GC-held "a+b" fds).
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.engine.generator import SamplingParams
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+from nats_llm_studio_tpu.store.manager import ModelStore, StoreError
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+
+from conftest import async_test
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# -- 2: batcher resets (not wedges) after a failed admit dispatch ------------
+
+
+@async_test
+async def test_failed_admit_resets_batcher(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    sp = SamplingParams(temperature=0.0, max_tokens=64)
+
+    orig = b._admit_fused
+    fail_next = {"on": False}
+
+    def poisoned(*a, **kw):
+        if fail_next["on"]:
+            fail_next["on"] = False
+            raise RuntimeError("simulated device OOM after donation")
+        return orig(*a, **kw)
+
+    b._admit_fused = poisoned
+
+    # stream A occupies a slot and keeps decoding
+    a_tokens = asyncio.Event()
+    a_err: list[BaseException] = []
+
+    async def run_a():
+        try:
+            async for _ in b.submit([1, 2, 3], sp):
+                a_tokens.set()
+        except RuntimeError as e:
+            a_err.append(e)
+
+    task_a = asyncio.create_task(run_a())
+    await asyncio.wait_for(a_tokens.wait(), timeout=30)
+
+    # B's admit dispatch fails after donating K/V: B gets the error...
+    fail_next["on"] = True
+    with pytest.raises(RuntimeError):
+        async for _ in b.submit([4, 5], sp):
+            pass
+    # ...and A is failed honestly by the cache reset (its KV rows are gone)
+    await asyncio.wait_for(task_a, timeout=30)
+    assert a_err and "reset" in str(a_err[0])
+
+    # the batcher is NOT wedged: a fresh request decodes normally
+    got = []
+    async for tok in b.submit([6, 7, 8], SamplingParams(temperature=0.0, max_tokens=4)):
+        got.append(tok)
+    assert len(got) == 4
+    await asyncio.to_thread(b.stop)
+
+
+# -- 3: URL pull hardening ---------------------------------------------------
+
+
+@async_test
+async def test_pull_url_rejects_unsafe_basenames(tmp_path):
+    ms = ModelStore(tmp_path / "models")
+    for bad in ("https://x.test/..gguf", "https://x.test/a/...gguf",
+                "https://x.test/%2e%2e.gguf", "https://x.test/-evil.gguf"):
+        with pytest.raises(StoreError, match="unsafe|expects"):
+            await ms.pull(bad)
+    # no network was touched: rejection happens before any fetch, so the
+    # cache dir must not have grown a 'downloads' publisher
+    assert not (tmp_path / "models" / "downloads").exists()
+
+
+def test_model_id_traversal_rejected(tmp_path):
+    """Client-controlled model ids become mkdir/rmtree targets via
+    model_dir()/delete_local(); hostile components must be rejected at the
+    split_model_id altitude so EVERY path (URL pull with model_id override,
+    bucket sync, delete) is covered."""
+    ms = ModelStore(tmp_path / "models")
+    for bad in ("../../etc", "pub/..", "..", "a/../b", "pub/.hidden",
+                "pub/mo\x00del", "pub\\win", ""):
+        with pytest.raises(StoreError, match="unsafe"):
+            ms.model_dir(bad)
+    # normal ids still work
+    assert ms.model_dir("meta-llama/Meta-Llama-3-8B-Instruct").name == (
+        "Meta-Llama-3-8B-Instruct"
+    )
+    assert ms.model_dir("granite-2b").parent.name == "local"
+
+
+@async_test
+async def test_pull_url_size_ceiling(tmp_path):
+    big = tmp_path / "big.gguf"
+    big.write_bytes(b"x" * 4096)
+    ms = ModelStore(tmp_path / "models", max_url_pull_bytes=1024)
+    with pytest.raises(StoreError, match="ceiling"):
+        await ms.pull(big.as_uri())
+    # nothing committed to the cache
+    assert not list((tmp_path / "models").rglob("*.gguf"))
+    # a file under the ceiling still pulls fine
+    small = tmp_path / "small.gguf"
+    small.write_bytes(b"y" * 512)
+    dest, _ = await ms.pull(small.as_uri())
+    assert dest.read_bytes() == b"y" * 512
+
+
+# -- 4: broker slow-consumer bound ------------------------------------------
+
+
+@async_test
+async def test_slow_consumer_dropped_with_bounded_memory():
+    broker = await EmbeddedBroker(max_pending=64 * 1024).start()
+    try:
+        # raw socket subscriber that stops reading after the handshake
+        reader, writer = await asyncio.open_connection("127.0.0.1", broker.port)
+        await reader.readline()  # INFO
+        writer.write(b"CONNECT {}\r\nSUB flood 1\r\nPING\r\n")
+        await writer.drain()
+        while (await reader.readline()).strip() != b"PONG":
+            pass
+        stalled_conn = next(iter(broker._clients))
+
+        nc = await connect(broker.url)
+        payload = b"z" * (64 * 1024)
+        # far beyond max_pending + any loopback TCP buffering
+        for _ in range(256):
+            await nc.publish("flood", payload)
+            if stalled_conn.closed:
+                break
+            await asyncio.sleep(0)
+        # the stalled client must be dropped, with its buffer bounded
+        for _ in range(200):
+            if stalled_conn.closed:
+                break
+            await asyncio.sleep(0.05)
+        assert stalled_conn.closed, "slow consumer was never dropped"
+        assert stalled_conn._pending <= broker.max_pending + broker.max_payload
+        # the publisher is unaffected
+        await nc.flush()
+        await nc.close()
+        writer.close()
+    finally:
+        await broker.stop()
+
+
+# -- 5: broker.stop() closes object-store log handles ------------------------
+
+
+@async_test
+async def test_store_module_closed_on_broker_stop(tmp_path):
+    from nats_llm_studio_tpu.store.objectstore import JetStreamStoreModule
+    from nats_llm_studio_tpu.transport.jetstream import ObjectStore
+
+    broker = await EmbeddedBroker().start()
+    module = JetStreamStoreModule(broker, store_dir=tmp_path / "js").install()
+    nc = await connect(broker.url)
+    store = ObjectStore(nc)
+    await store.ensure_bucket("b")
+    await store.put("b", "k.gguf", b"payload")
+    assert module._files  # an append-log handle is open
+    await nc.close()
+    await broker.stop()
+    assert not module._files  # closed deterministically, not left to GC

@@ -219,7 +219,7 @@ def test_client_against_real_nats_server(tmp_path):
             sub = await nc.subscribe("echo.svc")
 
             async def responder():
-                async for msg in sub.messages():
+                async for msg in sub:
                     await nc.publish(msg.reply, b"pong:" + msg.payload)
                     break
 

@@ -233,16 +233,22 @@ async def test_metrics_subject():
 
 @async_test
 async def test_profile_subject(tmp_path):
-    """profile — captures a jax.profiler trace and replies with its path."""
+    """profile — captures a jax.profiler trace and replies with its path.
+    A client-supplied 'dir' must be IGNORED (round-2 advisor, medium: bus
+    clients are untrusted; an honored path would be an arbitrary-directory
+    write primitive on the worker host)."""
     import os
 
     async with Harness() as h:
+        client_dir = tmp_path / "client-chosen"
         resp = await h.req(
-            "profile", {"seconds": 0.2, "dir": str(tmp_path / "trace")}, timeout=30.0
+            "profile", {"seconds": 0.2, "dir": str(client_dir)}, timeout=30.0
         )
         assert resp["ok"] is True
         trace_dir = resp["data"]["trace_dir"]
         assert os.path.isdir(trace_dir)
+        assert not client_dir.exists()  # the client's path was not honored
+        assert not str(trace_dir).startswith(str(tmp_path))
         found = []
         for root, _, files in os.walk(trace_dir):
             found += files
