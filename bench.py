@@ -1,16 +1,27 @@
-"""Benchmark: batched decode throughput + prefill TTFT on one chip.
+"""Benchmark: the north-star metric on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Model: flagship granite-3.0-2b geometry (BASELINE.md config 1) with random
-bf16 weights — throughput depends on shapes/dtypes, not weight values.
-Baseline reference: the north-star 2000 tok/s/chip (BASELINE.md config 2).
-Runs on the ambient JAX platform (real TPU under the driver; set
-JAX_PLATFORMS=cpu BENCH_TINY=1 for a smoke run).
+Headline (BASELINE.md config 2, the metric string itself names the model):
+**Llama-3-8B geometry, int8 weight-only, batched ring decode** — batch sweep
+{8, 16, 32}, best batch reported. Also measured, in `detail`:
+
+* `e2e` — the SAME 8B engine served end-to-end over the NATS wire
+  (`lmstudio.chat_model` streaming, 8 concurrent clients): TTFT p50/p95 and
+  aggregate tok/s. This is the honest "via nats req" number.
+* `long_prefill` — single-dispatch 16k-token flash prefill (SURVEY §5
+  long-context), tok/s and seconds.
+* `granite2b` — config-1 parity (the round-1/2 flagship), decode tok/s.
+
+Weights are random (throughput depends on shapes/dtypes, not values); the 8B
+bf16 tree would not fit HBM next to its int8 copy, so init streams one leaf
+at a time: create bf16 -> quantize on device -> free (peak = int8 model +
+one bf16 leaf). Set JAX_PLATFORMS=cpu BENCH_TINY=1 for a smoke run.
 """
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import time
@@ -25,22 +36,215 @@ def _sync(x) -> None:
     """Force completion: block_until_ready alone does not flush execution on
     every remote-device transport, a device->host copy does."""
     jax.block_until_ready(x)
-    np.asarray(x)
+    np.asarray(jax.tree.leaves(x)[0])
 
 from nats_llm_studio_tpu.engine.sampling import sample
 from nats_llm_studio_tpu.models.config import ModelConfig
-from nats_llm_studio_tpu.models.llama import ensure_lm_head, forward, init_params, make_cache
+from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+from nats_llm_studio_tpu.ops.wquant import quantizable, quantize_weight
 
 NORTH_STAR_TOK_S = 2000.0
 
+# Meta-Llama-3-8B-Instruct geometry (BASELINE.md config 2): 32 layers,
+# d=4096, ff=14336, GQA 32q/8kv, head_dim 128, vocab 128256, rope 500k.
+LLAMA3_8B = ModelConfig(
+    arch="llama",
+    vocab_size=128256,
+    d_model=4096,
+    n_layers=32,
+    n_heads=32,
+    n_kv_heads=8,
+    head_dim=128,
+    d_ff=14336,
+    rope_theta=500000.0,
+    max_seq_len=8192,
+    dtype="bfloat16",
+)
 
-def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> dict:
-    """End-to-end serving benchmark: embedded broker + worker + real engine,
-    driven via ``lmstudio.chat_model`` request/stream over the NATS wire —
-    BASELINE.md's metric definition ("via nats req"), not raw engine speed.
 
-    Returns {"ttft_p50_ms", "ttft_p95_ms", "e2e_tok_s", ...} measured at
-    ``n_concurrent`` streaming clients (after a compile warmup request).
+def init_params_int8(cfg: ModelConfig, seed: int = 0):
+    """Leaf-streamed random init, quantized on device.
+
+    8B bf16 is ~16 GB — materializing it before quantization would OOM a
+    16 GB chip. Each leaf is created and quantized inside one jit program
+    (the bf16 original is a program-local transient), then blocked on, so
+    peak HBM = int8 model so far + one bf16 leaf.
+    """
+    dt = cfg.dtype
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _randn(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dt)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _randq(k, shape):
+        w = (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dt)
+        return quantize_weight(w, device=True)
+
+    key = jax.random.PRNGKey(seed)
+    counter = [0]
+
+    def leaf(name: str, *shape):
+        counter[0] += 1
+        k = jax.random.fold_in(key, counter[0])
+        out = _randq(k, shape) if quantizable(name) else _randn(k, shape)
+        jax.block_until_ready(out)
+        return out
+
+    L, d, hq, hkv, hd, ff = (
+        cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, cfg.d_ff,
+    )
+    blocks = {
+        "attn_norm": jnp.ones((L, d), dt),
+        "ffn_norm": jnp.ones((L, d), dt),
+        "wq": leaf("wq", L, d, hq * hd),
+        "wk": leaf("wk", L, d, hkv * hd),
+        "wv": leaf("wv", L, d, hkv * hd),
+        "wo": leaf("wo", L, hq * hd, d),
+        "w_gate": leaf("w_gate", L, d, ff),
+        "w_up": leaf("w_up", L, d, ff),
+        "w_down": leaf("w_down", L, ff, d),
+    }
+    return {
+        "embed": leaf("embed", cfg.vocab_size, d),
+        "out_norm": jnp.ones((d,), dt),
+        "lm_head": leaf("lm_head", d, cfg.vocab_size),
+        "blocks": blocks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# device-side decode throughput (ring-slot scan, the serving hot path shape)
+# ---------------------------------------------------------------------------
+
+
+def decode_bench(cfg, params, batch, prompt_len, seq_len, steps) -> dict:
+    fwd = partial(forward, cfg=cfg)
+
+    # donate the cache: timing reruns prefill into the SAME buffers — a
+    # second [B, L, Hkv, S, D] cache next to params would OOM at batch 32
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def prefill(params, tokens, k, v, start):
+        logits, k, v = fwd(
+            params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start,
+            logit_positions=jnp.full((tokens.shape[0],), tokens.shape[1] - 1, jnp.int32),
+        )
+        return sample(logits[:, -1, :], jax.random.PRNGKey(1), temperature=0.0), k, v
+
+    def bucket_window(max_pos: int) -> int | None:
+        w = -(-(max_pos + 1) // 256) * 256
+        return w if w < seq_len else None
+
+    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4, 6))
+    def decode_n(params, tok, k, v, n, pos0, window):
+        """n decode steps as one device-side scan: measures chip throughput
+        without per-step host dispatch (the remote-device tunnel costs ~ms
+        per call, which would swamp a memory-bound step)."""
+
+        def body(carry, i):
+            tok, k, v = carry
+            pos = pos0 + i
+            logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v,
+                               start_pos=pos, ring_slot=pos[0] % k.shape[3],
+                               attn_window=window)
+            nxt = sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0)
+            return (nxt, k, v), nxt
+
+        (tok, k, v), toks = jax.lax.scan(body, (tok, k, v), jnp.arange(n, dtype=jnp.int32))
+        return tok, k, v, toks
+
+    k, v = make_cache(cfg, batch, seq_len)
+    tokens = jnp.ones((batch, prompt_len), jnp.int32)
+    start = jnp.zeros((batch,), jnp.int32)
+
+    tok, k, v = prefill(params, tokens, k, v, start)  # compile
+    _sync(tok)
+    t0 = time.perf_counter()
+    tok, k, v = prefill(params, tokens, k, v, start)
+    _sync(tok)
+    prefill_s = time.perf_counter() - t0
+
+    pos0 = jnp.full((batch,), prompt_len, jnp.int32)
+    window = bucket_window(prompt_len + 3 * steps)
+    tok, k, v, _ = decode_n(params, tok, k, v, steps, pos0, window)  # compile
+    _sync(tok)
+    pos0 = pos0 + steps
+    t0 = time.perf_counter()
+    tok, k, v, toks = decode_n(params, tok, k, v, steps, pos0, window)
+    _sync(toks)
+    dt = time.perf_counter() - t0
+    del k, v, tok, toks
+    gc.collect()
+    return {
+        "tok_s": round(batch * steps / dt, 1),
+        "prefill_s": round(prefill_s, 4),
+        "step_ms": round(1e3 * dt / steps, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# long-context prefill (single-dispatch flash kernel, SURVEY §5)
+# ---------------------------------------------------------------------------
+
+
+def long_prefill_bench(cfg, params, T: int) -> dict:
+    cfg = cfg.with_(max_seq_len=max(cfg.max_seq_len, T),
+                    use_flash_attention=jax.default_backend() == "tpu")
+    fwd = partial(forward, cfg=cfg)
+
+    @partial(jax.jit, donate_argnums=(2, 3))
+    def prefill(params, tokens, k, v, start):
+        logits, k, v = fwd(
+            params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start,
+            logit_positions=jnp.full((1,), tokens.shape[1] - 1, jnp.int32),
+            fresh_prefill=True,
+        )
+        return logits[:, -1, :], k, v
+
+    tokens = jnp.ones((1, T), jnp.int32)
+    start = jnp.zeros((1,), jnp.int32)
+    k, v = make_cache(cfg, 1, T)
+    logits, k, v = prefill(params, tokens, k, v, start)  # compile
+    _sync(logits)
+    t0 = time.perf_counter()
+    logits, k, v = prefill(params, tokens, k, v, start)
+    _sync(logits)
+    dt = time.perf_counter() - t0
+    del k, v, logits
+    gc.collect()
+    return {"tokens": T, "seconds": round(dt, 3), "tok_s": round(T / dt, 1)}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over the NATS wire (BASELINE.md's metric definition)
+# ---------------------------------------------------------------------------
+
+
+# the README example payload is a short single-turn chat (~15 prompt tokens,
+# /root/reference/README.md:227-230 usage block) — BASELINE.md config 2's
+# "chat_model req-reply (README example payload)" is measured with this shape
+SHORT_PROMPT = "Hello! Introduce yourself briefly."
+LONG_PROMPT = "benchmark prompt: " + "tell me about tensor processing units. " * 3
+
+
+def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
+                   clients_b: int = 32) -> dict:
+    """Embedded broker + worker + real engine, driven via
+    ``lmstudio.chat_model`` request/stream over the NATS wire.
+
+    Three measured phases on one serving stack (32 slots):
+      A. 8 concurrent clients, README-shaped short prompts -> TTFT p50/p95
+         (the BASELINE config-2 latency bar),
+      B. 32 concurrent clients x 64 tokens -> aggregate served tok/s
+         (vs the same round's device-scan number),
+      C. 8 clients, ~140-token prompts -> ttft_long p50 (honesty check for
+         heavier payloads).
+
+    The warmup covers every program the measured phases reach: group-admit
+    widths (mpad 1,2,4,8 — bursts above 8 split into pipelined groups of 8)
+    and every decode-window bucket (round-2 advisor: a fresh window compile
+    inside the timed phase skews TTFT p95).
     """
     import asyncio
 
@@ -52,7 +256,6 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
     from nats_llm_studio_tpu.serve.registry import JaxChatEngine
     from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
 
-    model_id = "bench/granite-2b"
     b2u = _byte_to_unicode()
     vocab = [b2u[i] for i in range(256)]
     vocab += [f"<filler_{i}>" for i in range(cfg.vocab_size - 257)]
@@ -60,9 +263,11 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
     tokenizer = GGUFTokenizer(
         "gpt2", vocab, merges=[], eos_id=cfg.vocab_size - 1, add_bos=False
     )
-    # default burst width (8): raising it to 16 gains ~13% aggregate tok/s
-    # but costs ~15% TTFT p50 (admits wait out a longer burst) — favor latency
-    batcher = ContinuousBatcher(params, cfg, max_slots=n_concurrent, max_seq_len=1024)
+    slots = int(os.environ.get("BENCH_E2E_SLOTS", str(max(clients_a, clients_b))))
+    batcher = ContinuousBatcher(
+        params, cfg, max_slots=slots, max_seq_len=512,
+        buckets=[64, 256, 512],
+    )
     engine = JaxChatEngine(model_id, batcher, tokenizer, cfg, meta={})
 
     class Preloaded(Registry):
@@ -86,15 +291,13 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
         def stats(self):
             return {"models_loaded": [model_id]}
 
-    prompt = "benchmark prompt: " + "tell me about tensor processing units. " * 3
-
     async def drive() -> dict:
         broker = await EmbeddedBroker().start()
         worker = Worker(WorkerConfig(nats_url=broker.url), Preloaded())
         await worker.start()
         nc = await connect(broker.url)
 
-        async def one_chat(tag: int) -> tuple[float, int, float]:
+        async def one_chat(tag: int, prompt: str, max_tokens: int):
             body = json.dumps(
                 {
                     "model": model_id,
@@ -118,190 +321,172 @@ def e2e_nats_bench(cfg, params, n_concurrent: int = 8, max_tokens: int = 32) -> 
                 n_tok += 1
             return ttft if ttft is not None else float("nan"), n_tok, time.perf_counter() - t0
 
-        # compile warmup: single admit, every padded group-admit width the
-        # measured phase might split into (mpad in {2, 4, ..}), and the
-        # decode burst — so no XLA compile lands inside the timed window
-        await one_chat(0)
+        async def wave(n: int, prompt: str, max_tokens: int, base_tag: int):
+            t0 = time.perf_counter()
+            results = await asyncio.gather(
+                *(one_chat(base_tag + i, prompt, max_tokens) for i in range(n))
+            )
+            wall = time.perf_counter() - t0
+            ttfts = sorted(r[0] * 1e3 for r in results if r[0] == r[0]) or [0.0]
+            toks = sum(r[1] for r in results)
+            return {
+                "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),
+                "ttft_p95_ms": round(ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))], 1),
+                "tok_s": round(toks / wall, 1),
+                "clients": n,
+                "max_tokens": max_tokens,
+            }
+
+        # compile warmup: single admit, group-admit widths 2/4/8, both
+        # prompt buckets (64 and 256), and every decode window the phases
+        # reach (the width waves sweep the ring head across 64/256/None)
+        await one_chat(0, SHORT_PROMPT, 16)
         w = 2
-        while w <= n_concurrent:
-            await asyncio.gather(*(one_chat(100 * w + i) for i in range(w)))
+        while w <= min(8, max(clients_a, clients_b)):
+            await asyncio.gather(
+                *(one_chat(100 * w + i, SHORT_PROMPT, 16) for i in range(w))
+            )
             w *= 2
-        t0 = time.perf_counter()
-        results = await asyncio.gather(*(one_chat(i + 1) for i in range(n_concurrent)))
-        wall = time.perf_counter() - t0
+        # long-prompt warmup at FULL phase-C width: the measured phase's
+        # group admit is mpad=clients_a at bucket 256 — a different program
+        # than the short-prompt waves; an unwarmed one costs seconds of
+        # compile inside the timed window
+        await asyncio.gather(
+            *(one_chat(900 + i, LONG_PROMPT, 16) for i in range(clients_a))
+        )
+
+        a = await wave(clients_a, SHORT_PROMPT, 32, base_tag=1000)
+        b = await wave(clients_b, SHORT_PROMPT, 64, base_tag=2000)
+        c = await wave(clients_a, LONG_PROMPT, 32, base_tag=4000)
         await nc.close()
         await worker.drain()
         await broker.stop()
         batcher.stop()
-        # a stream whose very first token is a stop token has no TTFT sample
-        ttfts = sorted(r[0] * 1e3 for r in results if r[0] == r[0]) or [0.0]
-        total_toks = sum(r[1] for r in results)
+
+        # the driver's chip is reached through a tunnel whose dispatch +
+        # readback round trip is ~100 ms (vs ~1 ms chip-local); TTFT pays
+        # two of them (launch ack, first-token readback). Measure the noop
+        # round trip and report it so the number is interpretable against
+        # the <200 ms bar defined for a local v5e.
+        noop = jax.jit(lambda x: x + 1)
+        z = jnp.zeros((8,), jnp.int32)
+        np.asarray(noop(z))
+        rts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(noop(z))
+            rts.append(time.perf_counter() - t0)
+        rt_ms = round(1e3 * sorted(rts)[1], 1)
+
         return {
-            "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),
-            "ttft_p95_ms": round(ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))], 1),
-            "e2e_tok_s": round(total_toks / wall, 1),
-            "clients": n_concurrent,
-            "max_tokens": max_tokens,
+            # flat headline keys, each labeled with ITS measurement's
+            # concurrency (phase A latency, phase B throughput)
+            "ttft_p50_ms": a["ttft_p50_ms"],  # config-2 latency bar, phase A
+            "ttft_p95_ms": a["ttft_p95_ms"],
+            "ttft_clients": a["clients"],
+            "e2e_tok_s": b["tok_s"],  # served throughput, phase B
+            "e2e_tok_s_clients": b["clients"],
+            "transport_rt_ms": rt_ms,
+            "ttft_p50_net_of_transport_ms": round(
+                max(0.0, a["ttft_p50_ms"] - 2 * rt_ms), 1
+            ),
+            "short_wave": a,
+            "throughput_wave": b,
+            "long_prompt_wave": c,
+            "batcher": batcher.stats.snapshot(),
         }
 
     return asyncio.run(drive())
 
 
+# ---------------------------------------------------------------------------
+
+
 def main() -> None:
     tiny = bool(os.environ.get("BENCH_TINY"))
+    detail: dict = {"quant": "int8", "platform": jax.devices()[0].platform}
+
     if tiny:
+        # smoke path: an UNQUANTIZED tiny model — named honestly so nobody
+        # mistakes a smoke line for an 8B int8 measurement
         cfg = ModelConfig.tiny()
-        batch, prompt_len, seq_len, steps = 2, 16, 64, 8
-    else:
-        from __graft_entry__ import GRANITE_2B
+        from nats_llm_studio_tpu.models.llama import ensure_lm_head
 
-        cfg = GRANITE_2B.with_(use_flash_attention=jax.default_backend() == "tpu")
-        # batch 32 is the serving sweet spot on one v5e chip: weight reads
-        # amortize 4x better than batch 8 while cache+weights still fit HBM
-        batch = int(os.environ.get("BENCH_BATCH", "32"))
-        prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
-        seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-        steps = int(os.environ.get("BENCH_STEPS", "128"))
-
-    quant = os.environ.get("BENCH_QUANT", "int8" if not tiny else "none")
-
-    def build_params():
         params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
-        if quant != "int8":
-            return params
-        # quantize on device: per-leaf absmax/round is fast there and avoids
-        # a 5 GB host round-trip. Pop leaves as they quantize so the bf16
-        # originals free eagerly — holding both copies OOMs at batch >= 48.
-        from nats_llm_studio_tpu.ops.wquant import quantizable, quantize_weight
+        r = decode_bench(cfg, params, batch=2, prompt_len=16, seq_len=64, steps=8)
+        print(json.dumps({
+            "metric": "tiny_smoke_decode_tok_s",
+            "value": r["tok_s"], "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "detail": {"quant": cfg.dtype, "platform": detail["platform"],
+                       "tiny": r},
+        }))
+        return
 
-        def q(path, leaf):
-            if not quantizable(path):
-                return leaf
-            out = quantize_weight(leaf, device=True)
-            jax.block_until_ready(out.q)
-            return out
+    # -- headline: Llama-3-8B int8, batch sweep -----------------------------
+    # flash prefill on the real chip (the serving stack's configuration;
+    # decode's T=1 path is unaffected by the flag)
+    cfg = LLAMA3_8B.with_(use_flash_attention=jax.default_backend() == "tpu")
+    params = init_params_int8(cfg)
+    batches = [int(b) for b in os.environ.get("BENCH_BATCHES", "8,16,32").split(",")]
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+    # seq 512 (not 1024): the b32 [B, L, Hkv, S, D] cache at 1024 puts the
+    # compile-time HBM estimate 0.4 GB over the 15.75 GB budget next to the
+    # 8.7 GB int8 params (the AOT path double-counts the donated cache);
+    # decode reads are window-bounded, so seq only sizes the allocation
+    seq_len = int(os.environ.get("BENCH_SEQ", "512"))
+    steps = int(os.environ.get("BENCH_STEPS", "128"))
+    sweep = {}
+    for b in batches:
+        sweep[f"b{b}"] = decode_bench(cfg, params, b, prompt_len, seq_len, steps)
+    best_b = max(sweep, key=lambda k: sweep[k]["tok_s"])
+    tok_s = sweep[best_b]["tok_s"]
+    detail["llama3_8b"] = {"sweep": sweep, "best": best_b,
+                           "prompt_len": prompt_len, "decode_steps": steps}
 
-        blocks = params.pop("blocks")
-        out_blocks = {}
-        for key in list(blocks.keys()):
-            out_blocks[key] = q(key, blocks.pop(key))
-        return {
-            "embed": params["embed"],
-            "out_norm": params["out_norm"],
-            "lm_head": q("lm_head", params.pop("lm_head")),
-            "blocks": out_blocks,
-        }
-
-    params = build_params()
-
-    fwd = partial(forward, cfg=cfg)
-
-    @jax.jit
-    def prefill(params, tokens, k, v, start):
-        logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v, start_pos=start)
-        return sample(logits[:, -1, :], jax.random.PRNGKey(1), temperature=0.0), k, v
-
-    def bucket_window(max_pos: int) -> int | None:
-        """Smallest 256-multiple covering every live slot (the batcher uses
-        its bucket list the same way pre-wrap); None = full cache."""
-        w = -(-(max_pos + 1) // 256) * 256
-        return w if w < seq_len else None
-
-    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(5,))
-    def decode(params, tok, k, v, pos, window):
-        # serving-path decode: ring write slot == position (uniform rows)
-        logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v, start_pos=pos,
-                           ring_slot=pos[0] % k.shape[3], attn_window=window)
-        return sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0), k, v
-
-    @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(4, 6))
-    def decode_n(params, tok, k, v, n, pos0, window):
-        """n decode steps as one device-side scan: measures chip throughput
-        without per-step host dispatch (the remote-device tunnel costs ~ms per
-        call, which would swamp a ~6 ms memory-bound step)."""
-
-        def body(carry, i):
-            tok, k, v = carry
-            pos = pos0 + i
-            logits, k, v = fwd(params, tokens=tok[:, None], k_cache=k, v_cache=v,
-                               start_pos=pos, ring_slot=pos[0] % k.shape[3],
-                               attn_window=window)
-            nxt = sample(logits[:, -1, :], jax.random.PRNGKey(2), temperature=0.0)
-            return (nxt, k, v), nxt
-
-        (tok, k, v), toks = jax.lax.scan(body, (tok, k, v), jnp.arange(n, dtype=jnp.int32))
-        return tok, k, v, toks
-
-    k, v = make_cache(cfg, batch, seq_len)
-    tokens = jnp.ones((batch, prompt_len), jnp.int32)
-    start = jnp.zeros((batch,), jnp.int32)
-
-    # compile both programs
-    tok, k, v = prefill(params, tokens, k, v, start)
-    pos = jnp.full((batch,), prompt_len, jnp.int32)
-    host_window = bucket_window(prompt_len + steps)
-    tok, k, v = decode(params, tok, k, v, pos, host_window)
-    _sync(tok)
-
-    # prefill latency (compiled)
-    k2, v2 = make_cache(cfg, batch, seq_len)
-    t0 = time.perf_counter()
-    tok2, k2, v2 = prefill(params, tokens, k2, v2, start)
-    _sync(tok2)
-    prefill_s = time.perf_counter() - t0
-    del k2, v2
-
-    # host-driven decode loop (includes per-step dispatch overhead)
-    t0 = time.perf_counter()
-    for i in range(steps):
-        pos = jnp.full((batch,), prompt_len + 1 + i, jnp.int32)
-        tok, k, v = decode(params, tok, k, v, pos, host_window)
-    _sync(tok)
-    host_dt = time.perf_counter() - t0
-    host_tok_s = batch * steps / host_dt
-
-    # device-side scan loop (chip throughput) — compile, then time a fresh run
-    pos0 = jnp.full((batch,), prompt_len + 1 + steps, jnp.int32)
-    window = bucket_window(prompt_len + 1 + 3 * steps)
-    tok, k, v, _ = decode_n(params, tok, k, v, steps, pos0, window)
-    _sync(tok)
-    pos0 = pos0 + steps
-    t0 = time.perf_counter()
-    tok, k, v, toks = decode_n(params, tok, k, v, steps, pos0, window)
-    _sync(toks)
-    dt = time.perf_counter() - t0
-    tok_s = batch * steps / dt
-
-    detail = {
-        "batch": batch,
-        "prompt_len": prompt_len,
-        "decode_steps": steps,
-        "quant": quant,
-        "prefill_s": round(prefill_s, 4),
-        "host_loop_tok_s": round(host_tok_s, 1),
-        "platform": jax.devices()[0].platform,
-    }
-
-    if not tiny and os.environ.get("BENCH_E2E", "1") != "0":
-        # free the raw-engine buffers before the serving stack builds its own
-        del k, v, tok, toks, params
+    # -- long-context prefill (16k, single flash dispatch) ------------------
+    if os.environ.get("BENCH_LONG", "1") != "0":
         try:
-            detail["e2e"] = e2e_nats_bench(cfg, build_params())
+            detail["long_prefill"] = long_prefill_bench(
+                cfg, params, int(os.environ.get("BENCH_LONG_T", "16384"))
+            )
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            detail["long_prefill_error"] = f"{type(e).__name__}: {e}"
+
+    # -- end-to-end over NATS with the SAME 8B engine ------------------------
+    if os.environ.get("BENCH_E2E", "1") != "0":
+        try:
+            detail["e2e"] = e2e_nats_bench(cfg, params, "bench/llama3-8b")
         except Exception as e:  # noqa: BLE001 — e2e is best-effort detail
             detail["e2e_error"] = f"{type(e).__name__}: {e}"
 
-    print(
-        json.dumps(
-            {
-                "metric": f"granite2b_{quant if quant != 'none' else cfg.dtype}_decode_tok_s"
-                + (".tiny" if tiny else f".b{batch}"),
-                "value": round(tok_s, 1),
-                "unit": "tok/s/chip",
-                "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 3),
-                "detail": detail,
-            }
-        )
-    )
+    del params
+    gc.collect()
+
+    # -- config-1 parity: granite-2b ----------------------------------------
+    if os.environ.get("BENCH_GRANITE", "1") != "0":
+        try:
+            from __graft_entry__ import GRANITE_2B
+
+            gcfg = GRANITE_2B.with_(
+                use_flash_attention=jax.default_backend() == "tpu"
+            )
+            gparams = init_params_int8(gcfg, seed=1)
+            detail["granite2b"] = decode_bench(
+                gcfg, gparams, 32, prompt_len, 1024, steps
+            )
+            del gparams
+            gc.collect()
+        except Exception as e:  # noqa: BLE001
+            detail["granite2b_error"] = f"{type(e).__name__}: {e}"
+
+    print(json.dumps({
+        "metric": f"llama3_8b_int8_decode_tok_s.{best_b}",
+        "value": tok_s,
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 3),
+        "detail": detail,
+    }))
 
 
 if __name__ == "__main__":

@@ -56,6 +56,7 @@ def _attention_block(
     allow_flash: bool = True,
     ring_slot: jax.Array | None = None,  # scalar: shared decode write slot
     mesh=None,  # enables the sp ring-attention prefill when the mesh has sp>1
+    fresh_prefill: bool = False,  # static: caller guarantees start_pos == 0
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -177,13 +178,21 @@ def _attention_block(
                 return ring_attention(q, k, v, cfg.attn_scale, mesh)
             return flash_attention_auto(q, k, v, cfg.attn_scale)
 
-        def _dense(ops):
-            q, k, v = ops[0], layer_slice(k_all), layer_slice(v_all)
-            return gqa_attention_hmajor(
-                q, k.astype(q.dtype), v.astype(q.dtype), mask[:, :, :win], cfg.attn_scale
-            )
+        if fresh_prefill:
+            # the caller guarantees start_pos == 0 (single-shot prefill /
+            # fused admits). Crucially this SKIPS COMPILING the dense
+            # branch: lax.cond compiles both sides, and the dense
+            # [B, Hkv, G, T, S] scores buffer at long context is itself a
+            # compile-time OOM (16k x 16k f32 = 32 GB)
+            out = _fresh_block((q, k, v))
+        else:
+            def _dense(ops):
+                q, k, v = ops[0], layer_slice(k_all), layer_slice(v_all)
+                return gqa_attention_hmajor(
+                    q, k.astype(q.dtype), v.astype(q.dtype), mask[:, :, :win], cfg.attn_scale
+                )
 
-        out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _dense, (q, k, v))
+            out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _dense, (q, k, v))
     else:
         out = gqa_attention_hmajor(
             q,
@@ -222,8 +231,16 @@ def forward(
     attn_window: int | None = None,  # static: attend to cache[:window] only
     mesh=None,  # static: enables the expert-parallel routed-MoE shard_map
     ring_slot: jax.Array | None = None,  # int32 scalar: shared decode write slot
+    logit_positions: jax.Array | None = None,  # int32 [B]: lm_head at these only
+    fresh_prefill: bool = False,  # static: start_pos==0 guaranteed; skips
+    # compiling the dense fallback branch (whose [B,Hkv,G,T,S] scores are a
+    # compile-time OOM at long context)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache).
+    """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache);
+    with ``logit_positions`` (per-row prompt-end indices) the logits are
+    [B, 1, vocab] — prefill callers that only sample the next token skip T×
+    the lm_head FLOPs and, decisively for long context, the [B, T, vocab]
+    f32 materialization (16k × 128k vocab would be 8.4 GB).
 
     Handles prefill (T > 1, start_pos = 0) and batched decode (T = 1,
     start_pos = current length per row) with one trace. Right-padded prompts
@@ -263,7 +280,7 @@ def forward(
             rms_norm(x, p["attn_norm"], cfg.rms_eps, cfg.norm_plus_one),
             p, cfg, k_all, v_all, layer,
             start_pos, cos, sin, mask, attn_window, allow_flash,
-            ring_slot if t == 1 else None, mesh,
+            ring_slot if t == 1 else None, mesh, fresh_prefill,
         )
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps, cfg.norm_plus_one)
@@ -298,6 +315,8 @@ def forward(
             block, (x, k_cache, v_cache), (params["blocks"], layer_idx)
         )
 
+    if logit_positions is not None and t > 1:
+        x = jnp.take_along_axis(x, logit_positions[:, None, None], axis=1)  # [B,1,d]
     x = rms_norm(x, params["out_norm"], cfg.rms_eps, cfg.norm_plus_one)
     lm_head = params.get("lm_head")
     if lm_head is None:

@@ -9,17 +9,20 @@ the routing weights. All shapes are static (XLA-friendly); token->slot
 movement is scatter/gather (O(N*k*D)), not the one-hot-matmul dispatch whose
 FLOPs explode at prefill token counts.
 
-Expert parallelism: under ``shard_map`` over the mesh's ``ep`` axis each
-shard owns E/P experts (weights arrive pre-sharded by
-``sharding.param_sharding_rules``), scatters the replicated tokens into its
-local slots, computes, and ``psum``s the combined output — the all-to-all of
-the reference's NCCL-style EP expressed as XLA collectives over ICI
-(SURVEY.md §7 hard part #4).
+Expert parallelism (SURVEY.md §7 hard part #4) is a true ALL-TO-ALL over
+the mesh's ``ep`` axis: tokens are sharded on ep, each shard routes its
+N/ep tokens locally, exchanges only the assigned slot payloads
+(``lax.all_to_all`` of [ep, E_local*C_pair, D] — per-shard bytes scale
+with cf*k*N/ep*D, NOT with N*D like a replicate+psum), computes its own
+E/ep experts over slots from every source, and a second all_to_all returns
+the outputs for a local weighted combine. Expert weights arrive
+pre-sharded on ep by ``sharding.param_sharding_rules``.
 
-Capacity: C = ceil(cf * k * N / E). Tokens overflowing an expert's C slots
-drop that expert's contribution (standard capacity-factor semantics; the
-routing weight mass is not renormalized). cf defaults high enough that
-drops require pathological routing skew.
+Capacity: per (source shard, expert) pair C_pair = ceil(cf * k * (N/ep)/E)
+slots (total per-expert capacity ep*C_pair). Tokens overflowing their
+pair's slots drop that expert's contribution (standard capacity-factor
+semantics; the routing weight mass is not renormalized). cf defaults high
+enough that drops require pathological routing skew.
 """
 
 from __future__ import annotations
@@ -42,9 +45,12 @@ def _capacity(n_tokens: int, cfg: ModelConfig, capacity_factor: float) -> int:
 
 
 def _route(xf: jax.Array, router, cfg: ModelConfig, capacity: int):
-    """Top-k routing + slot assignment. Returns (top_w [N,k] f32,
-    slot [N,k] int32 — global slot id e*C + position, or the trash slot
-    E*C for capacity overflow)."""
+    """Top-k routing + slot assignment over the tokens GIVEN (the whole
+    batch on a single shard; one shard's local block under EP — inside a
+    shard the id g*C + pos is exactly the local all-to-all send-buffer
+    layout dst*(E_local*C) + le*C + pos). Returns (top_w [N,k] f32,
+    slot [N,k] int32 — slot id e*C + position, or the trash slot E*C for
+    capacity overflow)."""
     n = xf.shape[0]
     e, k = cfg.n_experts, cfg.n_experts_used
     router_logits = q_einsum("nd,df->nf", xf, router).astype(jnp.float32)
@@ -78,14 +84,14 @@ def routed_moe_ffn(
     b, t, d = x.shape
     n = b * t
     e, k = cfg.n_experts, cfg.n_experts_used
-    cap = _capacity(n, cfg, capacity_factor)
     xf = x.reshape(n, d)
-    top_w, slot = _route(xf, p["router"], cfg, cap)
-    top_w = top_w.astype(x.dtype)
 
     ep = mesh.shape.get(AXIS_EP, 1) if mesh is not None else 1
     if ep <= 1:
         # single-shard: one global slot buffer (+1 trash row for drops)
+        cap = _capacity(n, cfg, capacity_factor)
+        top_w, slot = _route(xf, p["router"], cfg, cap)
+        top_w = top_w.astype(x.dtype)
         buf = jnp.zeros((e * cap + 1, d), x.dtype)
         buf = buf.at[slot.reshape(-1)].set(
             jnp.repeat(xf, k, axis=0), mode="drop", unique_indices=True
@@ -101,29 +107,59 @@ def routed_moe_ffn(
     e_local = e // ep
     espec = P(AXIS_EP, None, None)
 
-    def shard_fn(xf, top_w, slot, w_gate, w_up, w_down):
-        # xf/top_w/slot replicated; expert weights sharded on ep (leading E)
-        shard = jax.lax.axis_index(AXIS_EP)
-        lo = shard * e_local * cap
-        local = slot - lo  # [N,k] local slot id
-        # out-of-shard or trash assignments -> local trash row
-        local = jnp.where((local >= 0) & (local < e_local * cap), local, e_local * cap)
-        buf = jnp.zeros((e_local * cap + 1, d), xf.dtype)
-        buf = buf.at[local.reshape(-1)].set(
+    # --- all-to-all dispatch: tokens sharded on ep -------------------------
+    # pad N to a multiple of ep; pad rows are forced to the trash slot with
+    # zero routing weight so they consume no expert capacity
+    n_pad = -(-n // ep) * ep
+    c_pair = _capacity(n_pad // ep, cfg, capacity_factor)
+    if n_pad != n:
+        # pad rows sit at the END of the last shard's block: their running
+        # positions come after every real token's, so they cannot displace
+        # real assignments, and their output rows are dropped by [:n]
+        xf = jnp.concatenate([xf, jnp.zeros((n_pad - n, d), xf.dtype)])
+
+    trash = ep * e_local * c_pair
+    nspec = P(AXIS_EP, None)
+
+    def shard_fn(xf, router, w_gate, w_up, w_down):
+        # xf: this shard's N/ep token block (routing is genuinely LOCAL —
+        # router/top_k/cumsum run on local tokens only); expert weights
+        # sharded on ep (leading E axis), router replicated. Within a
+        # shard the blocks=1 slot formula g*C_pair + pos IS the local
+        # all-to-all send-buffer layout dst*(E_local*C_pair) + le*C_pair
+        # + pos, with the same trash id E*C_pair.
+        top_w, slot = _route(xf, router, cfg, c_pair)
+        top_w = top_w.astype(xf.dtype)
+        nl = xf.shape[0]
+        buf = jnp.zeros((trash + 1, d), xf.dtype)
+        buf = buf.at[slot.reshape(-1)].set(
             jnp.repeat(xf, k, axis=0), mode="drop", unique_indices=True
         )
-        ye = _expert_swiglu(
-            buf[: e_local * cap].reshape(e_local, cap, d), w_gate, w_up, w_down
-        ).reshape(e_local * cap, d)
-        ye = jnp.concatenate([ye, jnp.zeros((1, d), ye.dtype)])
-        picked = ye[local.reshape(-1)].reshape(n, k, d)
-        part = jnp.einsum("nkd,nk->nd", picked, top_w)
-        return jax.lax.psum(part, AXIS_EP)
+        send = buf[:trash].reshape(ep, e_local * c_pair, d)
+        # exchange slot payloads: recv[src] = src's tokens for MY experts
+        recv = jax.lax.all_to_all(send, AXIS_EP, split_axis=0, concat_axis=0)
+        xe = (
+            recv.reshape(ep, e_local, c_pair, d)
+            .transpose(1, 0, 2, 3)
+            .reshape(e_local, ep * c_pair, d)
+        )
+        ye = _expert_swiglu(xe, w_gate, w_up, w_down)
+        back = (
+            ye.reshape(e_local, ep, c_pair, d)
+            .transpose(1, 0, 2, 3)
+            .reshape(ep, e_local * c_pair, d)
+        )
+        # return outputs to their sources; row layout matches `send`
+        ret = jax.lax.all_to_all(back, AXIS_EP, split_axis=0, concat_axis=0)
+        ret = jnp.concatenate([ret.reshape(trash, d), jnp.zeros((1, d), ye.dtype)])
+        picked = ret[slot.reshape(-1)].reshape(nl, k, d)
+        return jnp.einsum("nkd,nk->nd", picked, top_w)
 
+    router_spec = jax.tree.map(lambda _: P(None, None), p["router"])
     out = shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(), espec, espec, espec),
-        out_specs=P(),
-    )(xf, top_w, slot, p["w_gate_e"], p["w_up_e"], p["w_down_e"])
-    return out.reshape(b, t, d)
+        in_specs=(nspec, router_spec, espec, espec, espec),
+        out_specs=nspec,
+    )(xf, p["router"], p["w_gate_e"], p["w_up_e"], p["w_down_e"])
+    return out[:n].reshape(b, t, d)

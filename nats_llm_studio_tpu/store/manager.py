@@ -143,6 +143,13 @@ class ModelStore:
         out = []
         for pub_dir in sorted(p for p in self.models_dir.iterdir() if p.is_dir()):
             for model_dir in sorted(p for p in pub_dir.iterdir() if p.is_dir()):
+                # only list ids that round-trip through split_model_id's
+                # validation — a legacy/hand-placed dir with an unsafe name
+                # would otherwise be advertised but impossible to load or
+                # delete over the bus (lookup/delete would raise)
+                if not (_SAFE_COMPONENT.match(pub_dir.name)
+                        and _SAFE_COMPONENT.match(model_dir.name)):
+                    continue
                 files = sorted(model_dir.glob("*.gguf"))
                 if files:
                     out.append(

@@ -18,7 +18,8 @@ def test_e2e_nats_bench_smoke():
 
     cfg = ModelConfig.tiny(vocab_size=300, n_layers=2, max_seq_len=256)
     params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
-    out = bench.e2e_nats_bench(cfg, params, n_concurrent=2, max_tokens=4)
-    assert set(out) >= {"ttft_p50_ms", "ttft_p95_ms", "e2e_tok_s", "clients"}
-    assert out["clients"] == 2
+    out = bench.e2e_nats_bench(cfg, params, "bench/tiny", clients_a=2, clients_b=2)
+    assert set(out) >= {"ttft_p50_ms", "ttft_p95_ms", "e2e_tok_s",
+                        "ttft_clients", "e2e_tok_s_clients", "transport_rt_ms"}
+    assert out["ttft_clients"] == 2 and out["e2e_tok_s_clients"] == 2
     assert out["ttft_p50_ms"] > 0 and out["e2e_tok_s"] > 0
