@@ -108,14 +108,16 @@ def routed_moe_ffn(
     espec = P(AXIS_EP, None, None)
 
     # --- all-to-all dispatch: tokens sharded on ep -------------------------
-    # pad N to a multiple of ep; pad rows are forced to the trash slot with
-    # zero routing weight so they consume no expert capacity
+    # pad N to a multiple of ep. Pad rows DO route (uniform top-k over the
+    # zero vector) and DO occupy capacity slots — correctness rests on
+    # ordering: pads are appended, so within the last shard's n-major
+    # cumsum every pad position comes AFTER every real token's. Pads can
+    # therefore overflow to trash but never displace a real assignment,
+    # and their combined outputs are discarded by out[:n]. Do not reorder
+    # the padding (interleaving or per-shard padding breaks this).
     n_pad = -(-n // ep) * ep
     c_pair = _capacity(n_pad // ep, cfg, capacity_factor)
     if n_pad != n:
-        # pad rows sit at the END of the last shard's block: their running
-        # positions come after every real token's, so they cannot displace
-        # real assignments, and their output rows are dropped by [:n]
         xf = jnp.concatenate([xf, jnp.zeros((n_pad - n, d), xf.dtype)])
 
     trash = ep * e_local * c_pair
