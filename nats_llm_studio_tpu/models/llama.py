@@ -315,14 +315,22 @@ def forward(
             block, (x, k_cache, v_cache), (params["blocks"], layer_idx)
         )
 
+    logits = lm_head_logits(params, cfg, x, logit_positions, t)
+    return logits, k_cache, v_cache
+
+
+def lm_head_logits(params: Params, cfg: ModelConfig, x: jax.Array,
+                   logit_positions: jax.Array | None, t: int) -> jax.Array:
+    """Shared output head (norm + lm_head, tied-embedding fallback,
+    logit_positions gather): the dense forward and the pipeline-parallel
+    forward (parallel/pipeline.py) must never diverge here."""
     if logit_positions is not None and t > 1:
         x = jnp.take_along_axis(x, logit_positions[:, None, None], axis=1)  # [B,1,d]
     x = rms_norm(x, params["out_norm"], cfg.rms_eps, cfg.norm_plus_one)
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    logits = mm(x, lm_head).astype(jnp.float32) * cfg.logit_scale
-    return logits, k_cache, v_cache
+    return mm(x, lm_head).astype(jnp.float32) * cfg.logit_scale
 
 
 def ensure_lm_head(params: Params) -> Params:

@@ -1,8 +1,9 @@
 """Mesh construction from a spec string (env var ``TPU_MESH``).
 
-Axis vocabulary: ``dp`` (data/batch), ``tp`` (tensor: heads + MLP), ``ep``
-(experts), ``sp`` (sequence/context — reserved for ring attention). A spec is
-``"tp=8"`` or ``"dp=2,tp=4"``; ``"auto"``/empty uses all local devices on tp.
+Axis vocabulary: ``dp`` (data/batch), ``pp`` (pipeline: layer stages), ``tp``
+(tensor: heads + MLP), ``ep`` (experts), ``sp`` (sequence/context — ring
+attention). A spec is ``"tp=8"`` or ``"dp=2,tp=4"``; ``"auto"``/empty uses
+all local devices on tp.
 
 Multi-host: when ``jax.distributed.initialize`` has run, ``jax.devices()``
 spans hosts and the same specs build DCN-crossing meshes; keep dp outermost
@@ -17,10 +18,13 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_TP = "tp"
 AXIS_EP = "ep"
 AXIS_SP = "sp"
-_KNOWN = (AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP)  # construction order: dp outermost
+# construction order: dp outermost (DCN-friendly), then pipeline stages,
+# then the intra-stage axes
+_KNOWN = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 def parse_mesh_spec(spec: str) -> dict[str, int]:

@@ -1,0 +1,183 @@
+"""Pipeline parallelism: GPipe-style microbatched inference over a ``pp``
+mesh axis.
+
+SURVEY.md §2.2 lists PP as the optional layer-stage sharding (the north-star
+configs fit v5e-8 with TP+int8, so PP is a capacity escape hatch, e.g. 70B
+bf16 across two hosts). Design, TPU-first:
+
+* the stacked ``[L]`` axis of every ``blocks.*`` leaf and of the KV cache
+  shards on ``pp`` — each stage owns ``L/P`` contiguous layers and ONLY its
+  slice of weights and cache ever lives on a chip (this is what makes PP a
+  capacity tool);
+* one ``shard_map`` over pp runs the classic GPipe schedule inside a single
+  jit: the batch splits into M microbatches, ``M + P - 1`` ticks scan over
+  the pipeline, each tick every stage runs its local layer stack on the
+  microbatch currently at its station and hands the activations to the next
+  stage via ``lax.ppermute`` over ICI (the reference's NCCL send/recv role,
+  compiler-scheduled);
+* bubbles (ticks where a stage has no valid microbatch) compute on clamped
+  indices and their cache writes are masked out — all shapes static, no
+  data-dependent control flow (XLA-friendly).
+
+The final hidden states are psum-broadcast off the last stage and the
+norm + lm_head run outside the shard_map, so sampling code is identical to
+the dense path. Works for prefill (T > 1, positional KV writes) and for
+batched decode (T = 1); the serving ring-decode path stays single-stage —
+PP targets capacity, the ring targets latency.
+
+Reference parity: the reference has no tensor plane at all (366 Go LoC of
+I/O glue, nats_llm_studio.go); its scale-out is queue-group replication
+(README.md:478-484). PP here is the in-tree answer for models that exceed
+one replica's HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.llama import _attention_block, _moe_ffn, lm_head_logits
+from ..ops.layers import rms_norm, rope_cos_sin, swiglu
+from .mesh import AXIS_PP
+
+
+def _run_local_stack(x, blocks, cfg: ModelConfig, k_loc, v_loc, start_pos,
+                     cos, sin, mask):
+    """One stage's layer stack (local ``[Lp]`` slice of blocks/cache) on one
+    microbatch. Positional KV writes (ring decode stays single-stage)."""
+
+    def block(carry, inputs):
+        x, k_loc, v_loc = carry
+        p, layer = inputs
+        attn_out, k_loc, v_loc = _attention_block(
+            rms_norm(x, p["attn_norm"], cfg.rms_eps, cfg.norm_plus_one),
+            p, cfg, k_loc, v_loc, layer, start_pos, cos, sin, mask,
+            None, False, None, None, False,
+        )
+        x = x + attn_out * cfg.residual_scale
+        h = rms_norm(x, p["ffn_norm"], cfg.rms_eps, cfg.norm_plus_one)
+        ffn = _moe_ffn(h, p, cfg) if cfg.is_moe else swiglu(
+            h, p["w_gate"], p["w_up"], p["w_down"], cfg.mlp_act
+        )
+        x = x + ffn * cfg.residual_scale
+        return (x, k_loc, v_loc), None
+
+    l_loc = k_loc.shape[1]
+    layer_idx = jnp.arange(l_loc, dtype=jnp.int32)
+    (x, k_loc, v_loc), _ = jax.lax.scan(block, (x, k_loc, v_loc),
+                                        (blocks, layer_idx))
+    return x, k_loc, v_loc
+
+
+def pipeline_forward(
+    params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # int32 [B, T]
+    k_cache: jax.Array,  # [B, L, Hkv, S, D], L sharded on pp
+    v_cache: jax.Array,
+    start_pos: jax.Array,  # int32 [B]
+    mesh: Mesh,
+    n_microbatches: int | None = None,
+    logit_positions: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Microbatched pipeline forward; same contract as ``models.llama.forward``
+    (positional mode). B must divide by M, L by the pp degree."""
+    pp = mesh.shape[AXIS_PP]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    b, t = tokens.shape
+    m = n_microbatches or min(pp, b)
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+    bm = b // m
+    dt = jnp.dtype(cfg.dtype)
+
+    # embed + rope tables, replicated (cheap relative to the layer stack)
+    x = params["embed"][tokens].astype(dt) * cfg.embedding_scale
+    positions = start_pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    s_max = k_cache.shape[3]
+    key_pos = jnp.arange(s_max, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, T, S]
+
+    def mb(a):  # [B, ...] -> [M, Bm, ...]
+        return a.reshape(m, bm, *a.shape[1:])
+
+    x_mb, cos_mb, sin_mb = mb(x), mb(cos), mb(sin)
+    mask_mb, sp_mb = mb(mask), mb(start_pos)
+
+    pspec = P(AXIS_PP)
+    bspec = jax.tree.map(lambda _: P(AXIS_PP), params["blocks"])
+
+    def stage_fn(x_mb, cos_mb, sin_mb, mask_mb, sp_mb, blocks, K, V):
+        s = jax.lax.axis_index(AXIS_PP)
+        n_ticks = m + pp - 1
+
+        def tick(carry, tck):
+            inbuf, K, V, hidden = carry
+            mbi = tck - s  # microbatch at my station this tick
+            valid = (mbi >= 0) & (mbi < m)
+            mbc = jnp.clip(mbi, 0, m - 1)
+            # stage 0 injects the fresh microbatch; later stages consume
+            # the activations handed over last tick
+            x_in = jnp.where(s == 0, x_mb[mbc], inbuf)
+            # slice this microbatch's cache rows, run my layers, write the
+            # rows back ONLY when the tick is real (bubble writes on the
+            # clamped index would corrupt microbatch 0 / m-1)
+            k_rows = jax.lax.dynamic_slice_in_dim(K, mbc * bm, bm, axis=0)
+            v_rows = jax.lax.dynamic_slice_in_dim(V, mbc * bm, bm, axis=0)
+            y, k_new, v_new = _run_local_stack(
+                x_in, blocks, cfg, k_rows, v_rows, sp_mb[mbc],
+                cos_mb[mbc], sin_mb[mbc], mask_mb[mbc],
+            )
+            K = jax.lax.dynamic_update_slice_in_dim(
+                K, jnp.where(valid, k_new, k_rows), mbc * bm, axis=0
+            )
+            V = jax.lax.dynamic_update_slice_in_dim(
+                V, jnp.where(valid, v_new, v_rows), mbc * bm, axis=0
+            )
+            # the LAST stage's finished microbatch lands in the output
+            # buffer; other stages contribute zeros (psum-broadcast below)
+            done = valid & (s == pp - 1)
+            upd = jnp.where(done, y, jax.lax.dynamic_slice_in_dim(
+                hidden, mbc * bm, bm, axis=0))
+            hidden = jax.lax.dynamic_update_slice_in_dim(
+                hidden, upd, mbc * bm, axis=0
+            )
+            # hand my activations to the next stage over ICI
+            nxt = jax.lax.ppermute(
+                y, AXIS_PP, [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            return (nxt, K, V, hidden), None
+
+        # initial carries must be marked pp-varying (the body's outputs are;
+        # shard_map's scan type check rejects the mismatch)
+        inbuf0 = jax.lax.pcast(
+            jnp.zeros((bm, t, cfg.d_model), dt), (AXIS_PP,), to="varying"
+        )
+        hidden0 = jax.lax.pcast(
+            jnp.zeros((m * bm, t, cfg.d_model), dt), (AXIS_PP,), to="varying"
+        )
+        (inbuf, K, V, hidden), _ = jax.lax.scan(
+            tick, (inbuf0, K, V, hidden0),
+            jnp.arange(n_ticks, dtype=jnp.int32),
+        )
+        # only stage P-1 holds real hidden states; psum broadcasts them
+        hidden = jax.lax.psum(
+            jnp.where(s == pp - 1, hidden, 0), AXIS_PP
+        )
+        return hidden, K, V
+
+    cache_pp = P(None, AXIS_PP, None, None, None)
+    hidden, k_cache, v_cache = shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), bspec, cache_pp, cache_pp),
+        out_specs=(P(), cache_pp, cache_pp),
+    )(x_mb, cos_mb, sin_mb, mask_mb, sp_mb, params["blocks"], k_cache, v_cache)
+
+    logits = lm_head_logits(params, cfg, hidden, logit_positions, t)
+    return logits, k_cache, v_cache

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..ops.wquant import QTensor
-from .mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP
+from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_SP, AXIS_TP
 
 
 def _axis(mesh: Mesh, name: str) -> str | None:
@@ -30,29 +30,31 @@ def _axis(mesh: Mesh, name: str) -> str | None:
 
 def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
     """PartitionSpec per params-pytree key (blocks.* keys are the stacked
-    per-layer weights)."""
+    per-layer weights). The leading [L] stack axis shards on pp (pipeline
+    stages own contiguous layer slices — parallel/pipeline.py)."""
     tp = _axis(mesh, AXIS_TP)
     ep = _axis(mesh, AXIS_EP)
+    pp = _axis(mesh, AXIS_PP)
     return {
         "embed": P(None, None),  # replicated: read once per token, cheap
         "out_norm": P(None),
         "lm_head": P(None, tp),  # vocab-sharded logits; argmax/sample gathers
-        "blocks.attn_norm": P(None, None),
-        "blocks.ffn_norm": P(None, None),
-        "blocks.wq": P(None, None, tp),
-        "blocks.wk": P(None, None, tp),
-        "blocks.wv": P(None, None, tp),
-        "blocks.wo": P(None, tp, None),
-        "blocks.bq": P(None, tp),  # qwen2 QKV biases: output-feature sharded
-        "blocks.bk": P(None, tp),
-        "blocks.bv": P(None, tp),
-        "blocks.w_gate": P(None, None, tp),
-        "blocks.w_up": P(None, None, tp),
-        "blocks.w_down": P(None, tp, None),
-        "blocks.router": P(None, None, None),
-        "blocks.w_gate_e": P(None, ep, None, tp),
-        "blocks.w_up_e": P(None, ep, None, tp),
-        "blocks.w_down_e": P(None, ep, tp, None),
+        "blocks.attn_norm": P(pp, None),
+        "blocks.ffn_norm": P(pp, None),
+        "blocks.wq": P(pp, None, tp),
+        "blocks.wk": P(pp, None, tp),
+        "blocks.wv": P(pp, None, tp),
+        "blocks.wo": P(pp, tp, None),
+        "blocks.bq": P(pp, tp),  # qwen2 QKV biases: output-feature sharded
+        "blocks.bk": P(pp, tp),
+        "blocks.bv": P(pp, tp),
+        "blocks.w_gate": P(pp, None, tp),
+        "blocks.w_up": P(pp, None, tp),
+        "blocks.w_down": P(pp, tp, None),
+        "blocks.router": P(pp, None, None),
+        "blocks.w_gate_e": P(pp, ep, None, tp),
+        "blocks.w_up_e": P(pp, ep, None, tp),
+        "blocks.w_down_e": P(pp, ep, tp, None),
     }
 
 
@@ -104,11 +106,12 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
 
 
 def cache_spec(mesh: Mesh) -> P:
-    """KV cache [B, L, Hkv, S, D]: batch on dp, heads on tp, sequence on sp
-    (the ring-attention axis — long prompts' cache memory scales down with
-    the sp degree; SURVEY.md §5 long-context)."""
+    """KV cache [B, L, Hkv, S, D]: batch on dp, layers on pp, heads on tp,
+    sequence on sp (the ring-attention axis — long prompts' cache memory
+    scales down with the sp degree; SURVEY.md §5 long-context)."""
     return P(
-        _axis(mesh, AXIS_DP), None, _axis(mesh, AXIS_TP), _axis(mesh, AXIS_SP), None
+        _axis(mesh, AXIS_DP), _axis(mesh, AXIS_PP), _axis(mesh, AXIS_TP),
+        _axis(mesh, AXIS_SP), None,
     )
 
 
@@ -122,8 +125,21 @@ def batch_spec(mesh: Mesh) -> P:
     return P(_axis(mesh, AXIS_DP))
 
 
-def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig) -> None:
-    """Fail fast on indivisible shardings instead of cryptic XLA errors."""
+def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig,
+                             allow_pp: bool = False) -> None:
+    """Fail fast on indivisible shardings instead of cryptic XLA errors.
+
+    ``allow_pp``: only callers that actually route through
+    ``parallel.pipeline.pipeline_forward`` may accept a pp axis. The dense
+    ``models.llama.forward`` over pp-sharded weights would not error — GSPMD
+    would silently all-gather every layer's weights per step — so the
+    serving path (default) rejects pp loudly instead."""
+    if not allow_pp and mesh.shape.get(AXIS_PP, 1) > 1:
+        raise ValueError(
+            "mesh has a pp axis but this path runs the dense forward; "
+            "pipeline parallelism is served by parallel.pipeline."
+            "pipeline_forward (use tp/dp/sp/ep for the serving mesh)"
+        )
     tp = mesh.shape.get(AXIS_TP, 1)
     ep = mesh.shape.get(AXIS_EP, 1)
     if cfg.n_kv_heads % tp and tp > 1:
@@ -137,3 +153,6 @@ def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig) -> None:
     sp = mesh.shape.get(AXIS_SP, 1)
     if sp > 1 and cfg.max_seq_len % sp:
         raise ValueError(f"max_seq_len={cfg.max_seq_len} not divisible by sp={sp}")
+    pp = mesh.shape.get(AXIS_PP, 1)
+    if pp > 1 and cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")

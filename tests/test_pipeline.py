@@ -1,0 +1,156 @@
+"""Pipeline parallelism: the microbatched pp forward must reproduce the
+dense single-device forward exactly — prefill, chunked continuation, and
+one-token decode — on the virtual 8-device CPU mesh (SURVEY.md §4.3/§4.4
+distributed test tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import forward, init_params, make_cache
+from nats_llm_studio_tpu.parallel import build_mesh
+from nats_llm_studio_tpu.parallel.pipeline import pipeline_forward
+from nats_llm_studio_tpu.parallel.sharding import (
+    shard_cache,
+    shard_params,
+    validate_mesh_for_config,
+)
+
+
+def _mesh(pp):
+    if len(jax.devices()) < pp:
+        pytest.skip(f"needs {pp} devices")
+    return build_mesh({"pp": pp}, jax.devices()[:pp])
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=8, max_seq_len=64, vocab_size=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.mark.parametrize("n_mb", [2, 4])
+def test_pp_prefill_matches_dense(model, n_mb):
+    cfg, params = model
+    mesh = _mesh(4)
+    validate_mesh_for_config(mesh, cfg, allow_pp=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 9), 0, cfg.vocab_size)
+    start = jnp.zeros((4,), jnp.int32)
+
+    k, v = make_cache(cfg, 4, 32)
+    want, wk, wv = forward(params, cfg, tokens, k, v, start)
+
+    sp = shard_params(params, mesh)
+    k, v = shard_cache(*make_cache(cfg, 4, 32), mesh)
+    got, gk, gv = jax.jit(
+        lambda p, tk, k, v, s: pipeline_forward(
+            p, cfg, tk, k, v, s, mesh=mesh, n_microbatches=n_mb
+        )
+    )(sp, tokens, k, v, start)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(wk),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(wv),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pp_decode_matches_dense(model):
+    """Prefill through the pipeline, then three single-token decode steps —
+    the cache handoff between calls must stay consistent."""
+    cfg, params = model
+    mesh = _mesh(4)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0, cfg.vocab_size)
+    start = jnp.zeros((2,), jnp.int32)
+
+    k, v = make_cache(cfg, 2, 32)
+    want, wk, wv = forward(params, cfg, tokens, k, v, start)
+
+    sp = shard_params(params, mesh)
+    gk, gv = shard_cache(*make_cache(cfg, 2, 32), mesh)
+    got, gk, gv = pipeline_forward(sp, cfg, tokens, gk, gv, start, mesh=mesh,
+                                   n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    nxt = jnp.argmax(want[:, -1, :], axis=-1).astype(jnp.int32)
+    for i in range(3):
+        pos = jnp.full((2,), 5 + i, jnp.int32)
+        want, wk, wv = forward(params, cfg, nxt[:, None], wk, wv, pos)
+        got, gk, gv = pipeline_forward(sp, cfg, nxt[:, None], gk, gv, pos,
+                                       mesh=mesh, n_microbatches=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"step {i}")
+        nxt = jnp.argmax(want[:, -1, :], axis=-1).astype(jnp.int32)
+
+
+def test_pp_chunked_continuation_matches_dense(model):
+    """T > 1 at start_pos > 0 (chunked prefill continuation): the positional
+    KV writes and the non-fresh attention path must stay consistent with
+    the dense forward across the chunk boundary."""
+    cfg, params = model
+    mesh = _mesh(4)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 9), 0, cfg.vocab_size)
+    first, second = tokens[:, :5], tokens[:, 5:]
+    zero = jnp.zeros((2,), jnp.int32)
+
+    k, v = make_cache(cfg, 2, 32)
+    _, wk, wv = forward(params, cfg, first, k, v, zero)
+    want, wk, wv = forward(params, cfg, second, wk, wv, jnp.full((2,), 5, jnp.int32))
+
+    sp = shard_params(params, mesh)
+    gk, gv = shard_cache(*make_cache(cfg, 2, 32), mesh)
+    _, gk, gv = pipeline_forward(sp, cfg, first, gk, gv, zero, mesh=mesh,
+                                 n_microbatches=2)
+    got, gk, gv = pipeline_forward(sp, cfg, second, gk, gv,
+                                   jnp.full((2,), 5, jnp.int32), mesh=mesh,
+                                   n_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pp_rejected_on_dense_serving_path(model):
+    """TPU_MESH=pp=N must fail loudly on the dense path — GSPMD would
+    otherwise silently all-gather every layer's weights per step."""
+    cfg, params = model
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match="pipeline"):
+        validate_mesh_for_config(mesh, cfg)
+
+
+def test_pp_logit_positions(model):
+    cfg, params = model
+    mesh = _mesh(4)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 7), 0, cfg.vocab_size)
+    start = jnp.zeros((2,), jnp.int32)
+    lp = jnp.asarray([6, 3], jnp.int32)
+
+    k, v = make_cache(cfg, 2, 32)
+    want, _, _ = forward(params, cfg, tokens, k, v, start)
+    sp = shard_params(params, mesh)
+    k, v = shard_cache(*make_cache(cfg, 2, 32), mesh)
+    got, _, _ = pipeline_forward(sp, cfg, tokens, k, v, start, mesh=mesh,
+                                 n_microbatches=2, logit_positions=lp)
+    np.testing.assert_allclose(np.asarray(got[0, 0]), np.asarray(want[0, 6]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got[1, 0]), np.asarray(want[1, 3]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pp_validation_errors(model):
+    cfg, params = model
+    mesh = _mesh(4)
+    sp = shard_params(params, mesh)
+    k, v = shard_cache(*make_cache(cfg, 4, 32), mesh)
+    tokens = jnp.ones((4, 4), jnp.int32)
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_forward(sp, cfg, jnp.ones((3, 4), jnp.int32), k, v,
+                         jnp.zeros((3,), jnp.int32), mesh=mesh, n_microbatches=2)
+    bad = cfg.with_(n_layers=6)
+    with pytest.raises(ValueError, match="divisible by pp"):
+        pipeline_forward(sp, bad, tokens, k, v, jnp.zeros((4,), jnp.int32),
+                         mesh=mesh)
