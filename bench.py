@@ -237,15 +237,18 @@ LONG_PROMPT = "benchmark prompt: " + "tell me about tensor processing units. " *
 
 
 def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
-                   clients_b: int = 32) -> dict:
+                   clients_b: int = 96) -> dict:
     """Embedded broker + worker + real engine, driven via
     ``lmstudio.chat_model`` request/stream over the NATS wire.
 
-    Three measured phases on one serving stack (32 slots):
+    Three measured phases on one serving stack (96 slots — int8 KV halves
+    per-slot cache so the serving batch rides the same b96 capacity
+    frontier the device-scan headline uses):
       A. 8 concurrent clients, README-shaped short prompts -> TTFT p50/p95
          (the BASELINE config-2 latency bar),
-      B. 32 concurrent clients x 64 tokens -> aggregate served tok/s
-         (vs the same round's device-scan number),
+      B. 96 concurrent clients x 128 tokens -> aggregate served tok/s
+         (vs the same round's device-scan number; long enough streams to
+         amortize the admit waves),
       C. 8 clients, ~140-token prompts -> ttft_long p50 (honesty check for
          heavier payloads).
 
@@ -325,10 +328,16 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
                 "lmstudio.chat_model", body, timeout=600.0, idle_timeout=300.0
             ):
                 if (msg.headers or {}).get("Nats-Stream-Done") is not None:
+                    # chunks coalesce decode bursts, so tokens are counted
+                    # from the aggregate's usage block, not per message
+                    try:
+                        done = json.loads(msg.payload)
+                        n_tok = done["data"]["response"]["usage"]["completion_tokens"]
+                    except Exception:  # noqa: BLE001 — error envelope
+                        pass
                     break
                 if ttft is None:
                     ttft = time.perf_counter() - t0
-                n_tok += 1
             return ttft if ttft is not None else float("nan"), n_tok, time.perf_counter() - t0
 
         async def wave(n: int, prompt: str, max_tokens: int, base_tag: int):
@@ -368,7 +377,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
             )
 
             a = await wave(clients_a, SHORT_PROMPT, 32, base_tag=1000)
-            b = await wave(clients_b, SHORT_PROMPT, 64, base_tag=2000)
+            b = await wave(clients_b, SHORT_PROMPT, 128, base_tag=2000)
             c = await wave(clients_a, LONG_PROMPT, 32, base_tag=4000)
         finally:
             # each step individually guarded: a dead connection must not
@@ -444,15 +453,18 @@ def main() -> None:
     # -- headline: Llama-3-8B int8, batch sweep -----------------------------
     # flash prefill on the real chip (the serving stack's configuration;
     # decode's T=1 path is unaffected by the flag); decode_unroll makes
-    # every per-layer cache access a static view — measured 1440 -> 1799
-    # tok/s at b32 (the lax.scan layer loop materializes cache slices)
+    # every per-layer cache access a static view (1440 -> 1799 tok/s at
+    # b32); int8 KV (ops/kvcache.py) halves cache traffic AND capacity,
+    # moving the batch frontier from b48 to b96 — measured b48 2608,
+    # b64 3436, b96 4391 tok/s. BENCH_KV=none reverts to the bf16 cache.
     on_tpu = jax.default_backend() == "tpu"
-    cfg = LLAMA3_8B.with_(use_flash_attention=on_tpu, decode_unroll=True)
+    kv = os.environ.get("BENCH_KV", "int8")
+    cfg = LLAMA3_8B.with_(use_flash_attention=on_tpu, decode_unroll=True,
+                          kv_quant=kv)
+    detail["kv_quant"] = kv
     params = init_params_int8(cfg)
-    # b48 is the HBM-capacity frontier at seq 512: the donated cache is
-    # double-counted by the AOT compile estimate, so b56+ trips the 15.75 GB
-    # budget next to the 8.7 GB int8 params. Measured: b32 1799, b48 2459.
-    batches = [int(b) for b in os.environ.get("BENCH_BATCHES", "8,16,32,48").split(",")]
+    batches = [int(b) for b in
+               os.environ.get("BENCH_BATCHES", "8,16,32,48,64,96").split(",")]
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     # seq 512 (not 1024): the b32 [B, L, Hkv, S, D] cache at 1024 puts the
     # compile-time HBM estimate 0.4 GB over the 15.75 GB budget next to the

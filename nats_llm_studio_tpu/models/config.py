@@ -39,6 +39,10 @@ class ModelConfig:
     mlp_act: str = "silu"  # "silu" | "gelu"
     norm_plus_one: bool = False
     dtype: str = "bfloat16"  # compute/weight dtype name (tests use float32)
+    # KV cache storage: "none" (cache in `dtype`) or "int8" (codes + per-
+    # position-per-head scales, ops/kvcache.py — halves decode's cache
+    # traffic and capacity, unlocking larger serving batches)
+    kv_quant: str = "none"
     # Pallas flash-attention for prefill (requires prefill at start_pos 0,
     # which the engine guarantees); decode keeps the fused XLA path
     use_flash_attention: bool = False

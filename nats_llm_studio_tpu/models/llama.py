@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.flash_attention import flash_attention_auto
+from ..ops.kvcache import KVQ, kv_update_slice
+from ..ops.kvcache import is_quantized as kv_is_quantized
 from ..ops.layers import (
     apply_rope,
     gqa_attention_hmajor,
@@ -78,10 +80,10 @@ def _attention_block(
     win = attn_window if (attn_window is not None and attn_window < s_max) else s_max
     is_ring_decode = t == 1 and ring_slot is not None
 
-    def layer_slice(cache):
+    def _slice_codes(codes):
         if isinstance(layer, int):  # unrolled decode: static slice = view
-            return cache[:, layer, :, :win]
-        sl = jax.lax.dynamic_slice(cache, (zero, layer, zero, zero, zero),
+            return codes[:, layer, :, :win]
+        sl = jax.lax.dynamic_slice(codes, (zero, layer, zero, zero, zero),
                                    (b, 1, hkv, win, d))
         if is_ring_decode and mesh is None and jax.default_backend() == "tpu":
             # RING decode only: the attention dot wants the slice S-minor
@@ -100,6 +102,23 @@ def _attention_block(
             )
         return sl[:, 0]
 
+    def layer_slice(cache):
+        if not kv_is_quantized(cache):
+            return _slice_codes(cache)
+        # KVQ: slice codes (with the layout treatment) and scales
+        if isinstance(layer, int):
+            s_sl = cache.s[:, layer, :, :win]
+        else:
+            s_sl = jax.lax.dynamic_slice(
+                cache.s, (zero, layer, zero, zero), (b, 1, hkv, win)
+            )[:, 0]
+        return KVQ(q=_slice_codes(cache.q), s=s_sl)
+
+    def as_attn_operand(slab):
+        """bf16 slabs cast to q.dtype; quantized slabs pass through (the
+        attention fn folds the scales outside the int8 dots)."""
+        return slab if kv_is_quantized(slab) else slab.astype(q.dtype)
+
     if is_ring_decode:
         # Ring decode (the serving hot path): every row writes its fresh
         # k/v at the SAME shared slot, so the cache update is ONE
@@ -110,14 +129,11 @@ def _attention_block(
         # the whole cache per step for the attention dot, ~3 ms/step).
         # Per-row validity is carried entirely by the ring mask built in
         # forward(); attention reads the full cache at measured ~400 GB/s.
-        upd_k = k.transpose(0, 2, 1, 3)[:, None].astype(k_all.dtype)  # [B,1,Hkv,1,D]
-        upd_v = v.transpose(0, 2, 1, 3)[:, None].astype(v_all.dtype)
-        k_all = jax.lax.dynamic_update_slice(
-            k_all, upd_k, (zero, layer, zero, ring_slot, zero)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            v_all, upd_v, (zero, layer, zero, ring_slot, zero)
-        )
+        upd_k = k.transpose(0, 2, 1, 3)[:, None]  # [B,1,Hkv,1,D]
+        upd_v = v.transpose(0, 2, 1, 3)[:, None]
+        idx = (zero, layer, zero, ring_slot, zero)
+        k_all = kv_update_slice(k_all, upd_k, idx)
+        v_all = kv_update_slice(v_all, upd_v, idx)
 
         # attn_window in ring mode is the caller's promise that the ring has
         # not wrapped yet (ring_slot < window and all live tokens sit below
@@ -125,8 +141,8 @@ def _attention_block(
         # wrap the caller must pass None and attention reads the full ring.
         out = gqa_attention_hmajor(
             q,
-            layer_slice(k_all).astype(q.dtype),
-            layer_slice(v_all).astype(q.dtype),
+            as_attn_operand(layer_slice(k_all)),
+            as_attn_operand(layer_slice(v_all)),
             mask[:, :, :win],
             cfg.attn_scale,
         )
@@ -147,9 +163,7 @@ def _attention_block(
     # structure that removed the scatter made XLA materialize+relayout the
     # slab per layer and lost more than the scatter costs.
     def write_row(cache_b, rows_b, s):  # cache_b [L,Hkv,S,D]; rows_b [Hkv,T,D]
-        return jax.lax.dynamic_update_slice(
-            cache_b, rows_b[None].astype(cache_b.dtype), (layer, zero, s, zero)
-        )
+        return kv_update_slice(cache_b, rows_b[None], (layer, zero, s, zero))
 
     write = jax.vmap(write_row)
     k_all = write(k_all, k.transpose(0, 2, 1, 3), start_pos)
@@ -189,15 +203,16 @@ def _attention_block(
             def _dense(ops):
                 q, k, v = ops[0], layer_slice(k_all), layer_slice(v_all)
                 return gqa_attention_hmajor(
-                    q, k.astype(q.dtype), v.astype(q.dtype), mask[:, :, :win], cfg.attn_scale
+                    q, as_attn_operand(k), as_attn_operand(v),
+                    mask[:, :, :win], cfg.attn_scale,
                 )
 
             out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _dense, (q, k, v))
     else:
         out = gqa_attention_hmajor(
             q,
-            layer_slice(k_all).astype(q.dtype),
-            layer_slice(v_all).astype(q.dtype),
+            as_attn_operand(layer_slice(k_all)),
+            as_attn_operand(layer_slice(v_all)),
             mask[:, :, :win],
             cfg.attn_scale,
         )
@@ -355,9 +370,17 @@ def make_cache(
     is contiguous and the decode attention dot streams it sequentially; the
     TP axis annotates Hkv and a sequence/ring axis annotates S without
     relayout (SURVEY.md §5). In ring-decode serving the S axis is a ring
-    indexed by a shared step counter, not per-row position (see forward)."""
+    indexed by a shared step counter, not per-row position (see forward).
+
+    With ``cfg.kv_quant == "int8"`` each cache is a ``KVQ`` pytree (int8
+    codes + f32 per-position-per-head scales, ops/kvcache.py) in the same
+    layout — half the HBM traffic and capacity per step."""
     s = seq_len or cfg.max_seq_len
     shape = (batch, cfg.n_layers, cfg.n_kv_heads, s, cfg.head_dim)
+    if cfg.kv_quant == "int8":
+        from ..ops.kvcache import kv_zeros
+
+        return kv_zeros(shape), kv_zeros(shape)
     dt = jnp.dtype(dtype or cfg.dtype)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 

@@ -1,0 +1,119 @@
+"""Quantized KV cache: int8 codes + per-(position, head) scales.
+
+Batched decode is HBM-bound (SURVEY.md §7 hard part #5); after int8 weights
+(ops/wquant.py) the next largest per-step read is the KV cache — at Llama-3-8B
+batch 48 x window 512 it is ~3 GB/step of bf16. Storing K/V as int8 halves
+that traffic AND halves cache capacity per slot, which is what lets the batch
+grow past the b48 HBM frontier (every extra row is ~free throughput on a
+memory-bound step).
+
+Design: symmetric absmax int8 over the head_dim axis — one f32 scale per
+(batch, layer, kv-head, position). Dequantization never materializes bf16
+slabs: attention folds the scales OUTSIDE the dots, so the MXU reads int8
+codes directly (XLA fuses convert(s8->bf16) into the dot operand read, the
+same mechanism that makes weight-only int8 pay off):
+
+    scores[b,h,t,s] = (q . codes[s]) * k_scale[s]      (scale on the S axis)
+    out[b,t,d]      = sum_s (p[s] * v_scale[s]) codes[s]  (fold into probs)
+
+``KVQ`` is a registered pytree, so a quantized cache flows through jit /
+scan / donation / shard_map exactly like the bf16 arrays it replaces; the
+scan's leading-axis slicing and dynamic_update_slice run per leaf via the
+helpers below.
+
+The reference reaches the same capability through llama.cpp's quantized KV
+options inside LM Studio (/root/reference/README.md:3-7); here it is a
+first-class device representation selected by ``ModelConfig.kv_quant``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVQ:
+    """Quantized cache tensor: ``value ~= q * s[..., None]``.
+
+    q: int8 codes, the cache layout [..., S, D]
+    s: f32 scales [..., S] (one per position per kv-head)
+    """
+
+    q: jax.Array
+    s: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+
+def is_quantized(cache) -> bool:
+    return isinstance(cache, KVQ)
+
+
+def kv_zeros(shape, sdtype=jnp.float32) -> KVQ:
+    """Zeroed quantized cache (codes 0 x any scale = 0; scales init to 1 so
+    never-written positions stay harmless)."""
+    return KVQ(q=jnp.zeros(shape, jnp.int8), s=jnp.ones(shape[:-1], sdtype))
+
+
+def quantize_rows(x: jax.Array) -> KVQ:
+    """Symmetric absmax int8 over the last (head_dim) axis."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = amax / 127.0
+    safe = jnp.where(s == 0, 1.0, s)
+    codes = jnp.clip(jnp.round(xf / safe), -127, 127).astype(jnp.int8)
+    return KVQ(q=codes, s=safe[..., 0])
+
+
+def kv_update_slice(cache, upd, idx):
+    """dynamic_update_slice on a bf16 cache, or per-leaf on a KVQ (the
+    update rows are quantized on write; ``idx`` indexes the CODES layout,
+    the scale write drops the trailing D index)."""
+    if not is_quantized(cache):
+        return jax.lax.dynamic_update_slice(cache, upd.astype(cache.dtype), idx)
+    uq = quantize_rows(upd)
+    return KVQ(
+        q=jax.lax.dynamic_update_slice(cache.q, uq.q, idx),
+        s=jax.lax.dynamic_update_slice(cache.s, uq.s, idx[:-1]),
+    )
+
+
+def kv_copy_slice(dst, src, idx):
+    """Write an ALREADY-QUANTIZED block (e.g. a prefilled row cache) into a
+    larger cache at ``idx`` (codes layout indices)."""
+    if not is_quantized(dst):
+        return jax.lax.dynamic_update_slice(dst, src.astype(dst.dtype), idx)
+    return KVQ(
+        q=jax.lax.dynamic_update_slice(dst.q, src.q, idx),
+        s=jax.lax.dynamic_update_slice(dst.s, src.s, idx[:-1]),
+    )
+
+
+def kv_slice(cache, idx, sizes):
+    """dynamic_slice in the codes layout; per-leaf on a KVQ."""
+    if not is_quantized(cache):
+        return jax.lax.dynamic_slice(cache, idx, sizes)
+    return KVQ(
+        q=jax.lax.dynamic_slice(cache.q, idx, sizes),
+        s=jax.lax.dynamic_slice(cache.s, idx[:-1], sizes[:-1]),
+    )
+
+
+def kv_roll_s(cache, shift, s_axis: int):
+    """jnp.roll along the sequence axis (ring alignment / compaction)."""
+    if not is_quantized(cache):
+        return jnp.roll(cache, shift, axis=s_axis)
+    return KVQ(
+        q=jnp.roll(cache.q, shift, axis=s_axis),
+        s=jnp.roll(cache.s, shift, axis=s_axis),
+    )

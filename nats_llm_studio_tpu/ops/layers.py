@@ -77,26 +77,44 @@ def gqa_attention(
 
 def gqa_attention_hmajor(
     q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
+    k,
+    v,
     mask: jax.Array,
     scale: float,
 ) -> jax.Array:
     """gqa_attention over a heads-major cache.
 
     q: [B, T, Hq, D]; k, v: [B, Hkv, S, D] (the KV-cache layout — per-head
-    slabs contiguous so decode DMA streams sequentially); mask: bool
-    [B, T, S]. Returns [B, T, Hq, D] in q.dtype.
+    slabs contiguous so decode DMA streams sequentially) as arrays in
+    q.dtype OR int8 ``KVQ`` slabs (ops/kvcache.py). Quantized slabs never
+    materialize bf16: the k scales fold onto the scores' S axis after the
+    QK dot, and the v scales fold into the probabilities before the PV dot,
+    so both MXU reads stream int8 codes. mask: bool [B, T, S]. Returns
+    [B, T, Hq, D] in q.dtype.
     """
+    from .kvcache import KVQ
+
     b, t, hq, d = q.shape
     hkv, s = k.shape[1], k.shape[2]
     g = hq // hkv
     qg = q.reshape(b, t, hkv, g, d)
-    logits = jnp.einsum("bthgd,bhsd->bhgts", qg, k, preferred_element_type=jnp.float32)
+    if isinstance(k, KVQ):
+        logits = jnp.einsum(
+            "bthgd,bhsd->bhgts", qg, k.q.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        ) * k.s[:, :, None, None, :]
+    else:
+        logits = jnp.einsum(
+            "bthgd,bhsd->bhgts", qg, k, preferred_element_type=jnp.float32
+        )
     logits = logits * scale
     logits = jnp.where(mask[:, None, None, :, :], logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhgts,bhsd->bthgd", probs.astype(v.dtype), v)
+    if isinstance(v, KVQ):
+        pv = (probs * v.s[:, :, None, None, :]).astype(q.dtype)
+        out = jnp.einsum("bhgts,bhsd->bthgd", pv, v.q.astype(q.dtype))
+    else:
+        out = jnp.einsum("bhgts,bhsd->bthgd", probs.astype(v.dtype), v)
     return out.reshape(b, t, hq, d)
 
 

@@ -7,11 +7,20 @@ all-gather/reduce-scatter from NamedSharding annotations (jit), no NCCL
 analog to hand-write — while NATS stays the control plane unchanged.
 """
 
-from .mesh import AXIS_DP, AXIS_EP, AXIS_SP, AXIS_TP, build_mesh, parse_mesh_spec
+from .mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    build_mesh,
+    parse_mesh_spec,
+)
 from .sharding import param_sharding_rules, shard_cache, shard_params
 
 __all__ = [
     "AXIS_DP",
+    "AXIS_PP",
     "AXIS_TP",
     "AXIS_EP",
     "AXIS_SP",

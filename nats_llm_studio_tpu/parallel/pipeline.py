@@ -88,6 +88,11 @@ def pipeline_forward(
     pp = mesh.shape[AXIS_PP]
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if cfg.kv_quant != "none":
+        raise NotImplementedError(
+            "pipeline_forward does not support quantized KV caches yet "
+            "(the stage loop slices caches per microbatch row-block)"
+        )
     b, t = tokens.shape
     m = n_microbatches or min(pp, b)
     if b % m:

@@ -116,8 +116,20 @@ def cache_spec(mesh: Mesh) -> P:
 
 
 def shard_cache(k_cache, v_cache, mesh: Mesh):
-    sh = NamedSharding(mesh, cache_spec(mesh))
-    return jax.device_put(k_cache, sh), jax.device_put(v_cache, sh)
+    from ..ops.kvcache import KVQ, is_quantized
+
+    spec = cache_spec(mesh)
+    sh = NamedSharding(mesh, spec)
+    # quantized caches: codes take the full cache spec, scales drop the
+    # trailing head_dim axis
+    sh_scale = NamedSharding(mesh, P(*list(spec)[:-1]))
+
+    def put(c):
+        if is_quantized(c):
+            return KVQ(q=jax.device_put(c.q, sh), s=jax.device_put(c.s, sh_scale))
+        return jax.device_put(c, sh)
+
+    return put(k_cache), put(v_cache)
 
 
 def batch_spec(mesh: Mesh) -> P:

@@ -34,6 +34,7 @@ from ..engine.generator import SamplingParams, default_buckets
 from ..models.config import ModelConfig
 from ..models.llama import forward, make_cache
 from ..engine.sampling import sample_rows
+from ..ops.kvcache import kv_copy_slice, kv_roll_s, kv_slice
 
 log = logging.getLogger(__name__)
 
@@ -150,10 +151,10 @@ class ContinuousBatcher:
             on every row's tokens being slot-contiguous there.
             """
             zero = jnp.zeros((), jnp.int32)
-            k1 = jnp.roll(k1, shift, axis=3)
-            v1 = jnp.roll(v1, shift, axis=3)
-            K = jax.lax.dynamic_update_slice(K, k1, (slot, zero, zero, zero, zero))
-            V = jax.lax.dynamic_update_slice(V, v1, (slot, zero, zero, zero, zero))
+            k1 = kv_roll_s(k1, shift, s_axis=3)
+            v1 = kv_roll_s(v1, shift, s_axis=3)
+            K = kv_copy_slice(K, k1, (slot, zero, zero, zero, zero))
+            V = kv_copy_slice(V, v1, (slot, zero, zero, zero, zero))
             first = sample_rows(
                 logits[:, 0], seed[None], jnp.zeros((1,), jnp.int32),
                 temp[None], topk[None], topp[None],
@@ -214,16 +215,16 @@ class ContinuousBatcher:
                 logits[:, 0], seeds, jnp.zeros((m,), jnp.int32), temps, topks, topps
             )
 
+            lkv, hkv, hd = km.shape[1], km.shape[2], km.shape[4]
+
             def body(carry, i):
                 K, V, tok = carry
-                k1 = jax.lax.dynamic_slice_in_dim(km, i, 1, axis=0)
-                v1 = jax.lax.dynamic_slice_in_dim(vm, i, 1, axis=0)
-                K = jax.lax.dynamic_update_slice(
-                    K, k1, (slots[i], zero, zero, offsets[i], zero)
-                )
-                V = jax.lax.dynamic_update_slice(
-                    V, v1, (slots[i], zero, zero, offsets[i], zero)
-                )
+                src_idx = (i, zero, zero, zero, zero)
+                size = (1, lkv, hkv, bucket, hd)
+                k1 = kv_slice(km, src_idx, size)
+                v1 = kv_slice(vm, src_idx, size)
+                K = kv_copy_slice(K, k1, (slots[i], zero, zero, offsets[i], zero))
+                V = kv_copy_slice(V, v1, (slots[i], zero, zero, offsets[i], zero))
                 tok = jax.lax.dynamic_update_slice(
                     tok, jax.lax.dynamic_slice_in_dim(firsts, i, 1), (slots[i],)
                 )
@@ -251,7 +252,7 @@ class ContinuousBatcher:
             a fresh head below max_seq again — the wrapped ring's recovery
             path (VERDICT r2 weak #7: without this, one wrap costs windowed
             attention reads for the rest of the worker's life)."""
-            return jnp.roll(K, shift, axis=3), jnp.roll(V, shift, axis=3)
+            return kv_roll_s(K, shift, s_axis=3), kv_roll_s(V, shift, s_axis=3)
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11, 12))
         def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp,
@@ -319,6 +320,23 @@ class ContinuousBatcher:
 
     # -- client API ----------------------------------------------------------
 
+    def _enqueue(self, prompt_ids: list[int], sp: SamplingParams) -> _Request:
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if len(prompt_ids) >= self.max_seq:
+            raise ValueError(f"prompt of {len(prompt_ids)} tokens >= max_seq {self.max_seq}")
+        req = _Request(
+            prompt_ids=list(prompt_ids),
+            sp=sp,
+            loop=asyncio.get_running_loop(),
+            out=asyncio.Queue(),
+        )
+        with self._submit_lock:
+            if self._stopping:
+                raise RuntimeError("batcher is stopped")
+            self._inbox.put(req)
+        return req
+
     async def submit(
         self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
     ) -> AsyncIterator[int]:
@@ -332,18 +350,7 @@ class ContinuousBatcher:
             self.start()
         if not prompt_ids:
             return
-        if len(prompt_ids) >= self.max_seq:
-            raise ValueError(f"prompt of {len(prompt_ids)} tokens >= max_seq {self.max_seq}")
-        req = _Request(
-            prompt_ids=list(prompt_ids),
-            sp=sp,
-            loop=asyncio.get_running_loop(),
-            out=asyncio.Queue(),
-        )
-        with self._submit_lock:
-            if self._stopping:
-                raise RuntimeError("batcher is stopped")
-            self._inbox.put(req)
+        req = self._enqueue(prompt_ids, sp)
         while True:
             kind, value = await req.out.get()
             if kind == "tok":
@@ -354,6 +361,42 @@ class ContinuousBatcher:
                 return
             else:
                 raise value
+
+    async def submit_batched(
+        self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
+    ) -> AsyncIterator[list[int]]:
+        """Like ``submit`` but yields LISTS of tokens: everything already
+        delivered when the consumer wakes comes out as one batch. A decode
+        burst lands on the event loop as ``decode_burst`` tokens at once,
+        so the streaming layer can publish one NATS chunk per burst instead
+        of per token — at 64+ concurrent streams the per-message publish
+        overhead is a measurable share of served throughput."""
+        if not self._started:
+            self.start()
+        if not prompt_ids:
+            return
+        req = self._enqueue(prompt_ids, sp)
+        while True:
+            kind, value = await req.out.get()
+            batch: list[int] = []
+            while True:
+                if kind == "tok":
+                    batch.append(value)
+                elif kind == "end":
+                    if batch:
+                        yield batch
+                    if info is not None:
+                        info["finish_reason"] = value
+                    return
+                else:
+                    if batch:
+                        yield batch
+                    raise value
+                try:
+                    kind, value = req.out.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            yield batch
 
     # -- device loop (owner thread) ------------------------------------------
 

@@ -120,11 +120,16 @@ class JaxChatEngine(ChatEngine):
         emitted = 0
         end_info: dict = {}
         try:
-            async for tok_id in self.batcher.submit(prompt_ids, sp, info=end_info):
+            # batched iteration: a decode burst's tokens land as ONE chunk
+            # message (the delta simply carries more text) — per-message
+            # publish overhead is a real share of throughput at 64+ streams
+            async for tok_batch in self.batcher.submit_batched(
+                prompt_ids, sp, info=end_info
+            ):
                 if not toks:
                     stats.ttft_s = time.perf_counter() - t0
-                toks.append(tok_id)
-                stats.completion_tokens += 1
+                toks.extend(tok_batch)
+                stats.completion_tokens += len(tok_batch)
                 # decode incrementally; emit only completed UTF-8 text
                 text = self.tokenizer.decode(toks)
                 if len(text) > emitted and not text.endswith("�"):
