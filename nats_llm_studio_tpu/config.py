@@ -42,6 +42,9 @@ class WorkerConfig:
     # "none" (serve in cfg dtype) or "int8" (weight-only per-channel int8:
     # halves HBM weight traffic and fits 70B-class models on a v5e-8)
     quant_mode: str = field(default_factory=lambda: _env("TPU_QUANT", "none"))
+    # "none" or "int8": quantized serving KV cache (ops/kvcache.py) — halves
+    # decode cache traffic and per-slot HBM
+    kv_quant_mode: str = field(default_factory=lambda: _env("TPU_KV_QUANT", "none"))
     # comma-separated URL schemes pull_model may fetch directly; https-only
     # by default on serving workers (bus clients must not be able to SSRF
     # through the worker or read its local files). Empty string disables.

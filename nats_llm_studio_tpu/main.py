@@ -76,7 +76,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
                        url_schemes=schemes, max_url_pull_bytes=cfg.max_url_pull_bytes)
     registry = LocalRegistry(
         store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots,
-        quant=cfg.quant_mode,
+        quant=cfg.quant_mode, kv_quant=cfg.kv_quant_mode,
     )
     worker = Worker(cfg, registry)
     await worker.start()

@@ -346,21 +346,9 @@ class ContinuousBatcher:
         "shutdown") is recorded in ``info["finish_reason"]`` so callers report
         cache-capacity terminations truthfully instead of re-deriving from
         token counts."""
-        if not self._started:
-            self.start()
-        if not prompt_ids:
-            return
-        req = self._enqueue(prompt_ids, sp)
-        while True:
-            kind, value = await req.out.get()
-            if kind == "tok":
-                yield value
-            elif kind == "end":
-                if info is not None:
-                    info["finish_reason"] = value
-                return
-            else:
-                raise value
+        async for batch in self.submit_batched(prompt_ids, sp, info=info):
+            for tok in batch:
+                yield tok
 
     async def submit_batched(
         self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None

@@ -202,6 +202,7 @@ class LocalRegistry(Registry):
         max_seq_len: int | None = None,
         max_batch_slots: int = 8,
         quant: str = "none",
+        kv_quant: str = "none",
     ):
         self.store = store
         self.mesh = mesh
@@ -209,6 +210,10 @@ class LocalRegistry(Registry):
         self.max_seq_len = max_seq_len
         self.max_batch_slots = max_batch_slots
         self.quant = quant
+        # "int8": store the serving KV cache quantized (ops/kvcache.py) —
+        # halves decode cache traffic and per-slot HBM, so the same chip
+        # serves ~2x the concurrent slots
+        self.kv_quant = kv_quant
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
         self._requests = 0
@@ -291,6 +296,7 @@ class LocalRegistry(Registry):
             dtype=self.dtype,
             use_flash_attention=jax.default_backend() == "tpu",  # prefill TTFT
             use_routed_moe=True,  # sparse dispatch (parallel/moe.py)
+            kv_quant=self.kv_quant,
         )
         tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
         quant = {t.ggml_type.name for t in reader.tensors.values()}
