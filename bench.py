@@ -467,7 +467,10 @@ def main() -> None:
     # (b56+ trips the 15.75 GB AOT compile budget next to the 8.7 GB int8
     # params — the estimate double-counts the donated cache); int8 KV halves
     # the cache and moves it to b96
-    default_batches = "8,16,32,48,64,96" if kv == "int8" else "8,16,32,48"
+    # b80 rides below the b96 HBM-pressure edge (b96 swings ~15% run to run
+    # as the allocator sits ~0.5 GB from the ceiling); best-of reports it
+    # when b96 lands on a bad run
+    default_batches = "8,16,32,48,64,80,96" if kv == "int8" else "8,16,32,48"
     batches = [int(b) for b in
                os.environ.get("BENCH_BATCHES", default_batches).split(",")]
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))

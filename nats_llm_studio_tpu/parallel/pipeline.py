@@ -88,11 +88,6 @@ def pipeline_forward(
     pp = mesh.shape[AXIS_PP]
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
-    if cfg.kv_quant != "none":
-        raise NotImplementedError(
-            "pipeline_forward does not support quantized KV caches yet "
-            "(the stage loop slices caches per microbatch row-block)"
-        )
     b, t = tokens.shape
     m = n_microbatches or min(pp, b)
     if b % m:
@@ -131,19 +126,29 @@ def pipeline_forward(
             x_in = jnp.where(s == 0, x_mb[mbc], inbuf)
             # slice this microbatch's cache rows, run my layers, write the
             # rows back ONLY when the tick is real (bubble writes on the
-            # clamped index would corrupt microbatch 0 / m-1)
-            k_rows = jax.lax.dynamic_slice_in_dim(K, mbc * bm, bm, axis=0)
-            v_rows = jax.lax.dynamic_slice_in_dim(V, mbc * bm, bm, axis=0)
+            # clamped index would corrupt microbatch 0 / m-1). Row slices
+            # and gated writes go through tree_map so a quantized KVQ cache
+            # (codes + scales) moves as one unit.
+            def rows(c):
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(a, mbc * bm, bm, axis=0), c
+                )
+
+            def write_rows(c, new, old):
+                return jax.tree.map(
+                    lambda a, n, o: jax.lax.dynamic_update_slice_in_dim(
+                        a, jnp.where(valid, n, o), mbc * bm, axis=0
+                    ),
+                    c, new, old,
+                )
+
+            k_rows, v_rows = rows(K), rows(V)
             y, k_new, v_new = _run_local_stack(
                 x_in, blocks, cfg, k_rows, v_rows, sp_mb[mbc],
                 cos_mb[mbc], sin_mb[mbc], mask_mb[mbc],
             )
-            K = jax.lax.dynamic_update_slice_in_dim(
-                K, jnp.where(valid, k_new, k_rows), mbc * bm, axis=0
-            )
-            V = jax.lax.dynamic_update_slice_in_dim(
-                V, jnp.where(valid, v_new, v_rows), mbc * bm, axis=0
-            )
+            K = write_rows(K, k_new, k_rows)
+            V = write_rows(V, v_new, v_rows)
             # the LAST stage's finished microbatch lands in the output
             # buffer; other stages contribute zeros (psum-broadcast below)
             done = valid & (s == pp - 1)
@@ -176,7 +181,15 @@ def pipeline_forward(
         )
         return hidden, K, V
 
-    cache_pp = P(None, AXIS_PP, None, None, None)
+    # cache layers shard on pp; a quantized KVQ cache carries a spec per
+    # leaf (the scale tensor has no trailing head_dim axis)
+    from ..ops.kvcache import KVQ, is_quantized
+
+    full = P(None, AXIS_PP, None, None, None)
+    cache_pp = (
+        KVQ(q=full, s=P(None, AXIS_PP, None, None))
+        if is_quantized(k_cache) else full
+    )
     hidden, k_cache, v_cache = shard_map(
         stage_fn,
         mesh=mesh,
