@@ -524,7 +524,14 @@ class ContinuousBatcher:
                 dirty = False
             # cap the burst so no active row can run past the cache capacity.
             # n is a static jit arg: snap to single steps near capacity
-            # instead of counting down through n-1 fresh compiles
+            # instead of counting down through n-1 fresh compiles.
+            # NOTE: with the depth-2 pipeline, host_pos may TRANSIENTLY sit at
+            # or past max_seq for a row whose terminal burst is still awaiting
+            # readback (the delivery in process_record ends it with "length").
+            # Those zombie steps are safe: the ring mask's mod-S arithmetic
+            # degrades to full-window attention once start_pos >= max_seq, so
+            # the extra decode computes a token nobody delivers — headroom
+            # may be <= 0 here and n=1 covers it.
             headroom = self.max_seq - 1 - max(host_pos[i] for i in act)
             n = self.decode_burst if headroom >= self.decode_burst else 1
             # until the ring wraps, every live slot index is < ring_next:

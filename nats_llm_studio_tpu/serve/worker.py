@@ -260,7 +260,14 @@ class Worker:
             )
             seq += 1
         if final is None:
-            final = await engine.chat(payload)
+            # An engine whose stream ends without the terminal chat.completion
+            # aggregate is broken: regenerating via engine.chat() here would
+            # silently double the cost AND could return a different completion
+            # than the chunks already streamed. Fail loudly instead; the
+            # caller's handler turns this into a terminal error envelope.
+            raise EngineError(
+                "engine stream ended without a chat.completion aggregate"
+            )
         usage = final.get("usage") or {}
         self._tokens_total += usage.get("completion_tokens", 0)
         await self.nc.publish(

@@ -87,24 +87,47 @@ class CachedModel:
 
 
 # every path component a model id may contribute to the cache layout: must
-# start alphanumeric (excludes '.', '..', hidden files) and stay in a
+# start alphanumeric (excludes '.', '..', hidden files), stay in a
 # conservative charset (excludes separators, NUL, '~', '%'-escapes resolving
-# later). Model ids are CLIENT-CONTROLLED (pull/delete/sync subjects), and
-# model_dir()/delete_local() turn them into mkdir/rmtree targets.
-_SAFE_COMPONENT = re.compile(r"[A-Za-z0-9][A-Za-z0-9._\- ]*\Z")
+# later), and not END in '.' or ' ' — Windows strips those, so two distinct
+# advertised ids would collide on one directory there. Trailing '_'/'-' are
+# safe on every platform and stay allowed (ids cached by earlier releases
+# must remain listable/deletable). Model ids are CLIENT-CONTROLLED
+# (pull/delete/sync subjects), and model_dir()/delete_local() turn them into
+# mkdir/rmtree targets.
+_SAFE_COMPONENT = re.compile(r"[A-Za-z0-9](?:[A-Za-z0-9._\- ]*[A-Za-z0-9_\-])?\Z")
+# lenient variant for dirs that ALREADY exist in the cache (written by an
+# earlier release whose pattern allowed trailing '.'): same conservative
+# charset — no traversal, no separators — so listing/deleting them stays
+# safe ON POSIX; only CREATION is held to the strict pattern. Without this
+# a legacy 'pub/llama3.' dir could never be reclaimed over the bus.
+# Trailing SPACE stays excluded even here: split_model_id's whole-id strip
+# collapses 'pub/llama3 ' to 'pub/llama3', so a trailing-space id can only
+# ever alias its sibling (rmtree the WRONG model) — those dirs were never
+# addressable over the bus and must not be advertised. On Windows the
+# lenient mode is DISABLED: the filesystem strips trailing '.' on access,
+# so 'pub/llama3.' would alias the distinct strict-valid 'pub/llama3' —
+# and legacy trailing-dot dirs cannot exist there anyway (uncreatable).
+_SAFE_COMPONENT_LEGACY = re.compile(r"[A-Za-z0-9](?:[A-Za-z0-9._\- ]*[A-Za-z0-9._\-])?\Z")
 
 
-def split_model_id(model_id: str) -> tuple[str, str]:
+def split_model_id(model_id: str, strict: bool = True) -> tuple[str, str]:
     """"publisher/model" -> (publisher, model); bare names get publisher
     "local" (mirrors the reference's fallback of deriving the publisher from
     the id prefix, nats_llm_studio.go:112-118, without the duplication).
 
     Every '/'-separated component is validated against a conservative
     pattern: a hostile id like '../../../etc' must never become a
-    filesystem path (model_dir -> mkdir; delete_local -> rmtree)."""
+    filesystem path (model_dir -> mkdir; delete_local -> rmtree).
+    ``strict=False`` (lookup/list/delete of dirs that already exist) accepts
+    the legacy charset on POSIX so caches written by earlier releases stay
+    reachable; creation paths — and everything on Windows, where trailing
+    '.'/' ' alias other dirs — always use the strict pattern."""
     model_id = model_id.strip().strip("/")
+    lenient = not strict and os.name != "nt"
+    pattern = _SAFE_COMPONENT_LEGACY if lenient else _SAFE_COMPONENT
     for comp in model_id.split("/"):
-        if not _SAFE_COMPONENT.match(comp):
+        if not pattern.match(comp):
             raise StoreError(f"unsafe model id component {comp!r} in {model_id!r}")
     if "/" in model_id:
         pub, _, name = model_id.partition("/")
@@ -135,8 +158,8 @@ class ModelStore:
 
     # -- local cache ---------------------------------------------------------
 
-    def model_dir(self, model_id: str) -> Path:
-        pub, name = split_model_id(model_id)
+    def model_dir(self, model_id: str, strict: bool = True) -> Path:
+        pub, name = split_model_id(model_id, strict=strict)
         return self.models_dir / pub / name
 
     def cached(self) -> list[CachedModel]:
@@ -144,11 +167,13 @@ class ModelStore:
         for pub_dir in sorted(p for p in self.models_dir.iterdir() if p.is_dir()):
             for model_dir in sorted(p for p in pub_dir.iterdir() if p.is_dir()):
                 # only list ids that round-trip through split_model_id's
-                # validation — a legacy/hand-placed dir with an unsafe name
+                # lenient validation — a hand-placed dir with an unsafe name
                 # would otherwise be advertised but impossible to load or
-                # delete over the bus (lookup/delete would raise)
-                if not (_SAFE_COMPONENT.match(pub_dir.name)
-                        and _SAFE_COMPONENT.match(model_dir.name)):
+                # delete over the bus (lookup/delete would raise). The
+                # LEGACY pattern here keeps caches from earlier releases
+                # (trailing '.'/' ') listable and reclaimable.
+                if not (_SAFE_COMPONENT_LEGACY.match(pub_dir.name)
+                        and _SAFE_COMPONENT_LEGACY.match(model_dir.name)):
                     continue
                 files = sorted(model_dir.glob("*.gguf"))
                 if files:
@@ -164,17 +189,18 @@ class ModelStore:
         return out
 
     def lookup(self, model_id: str) -> CachedModel | None:
-        d = self.model_dir(model_id)
+        d = self.model_dir(model_id, strict=False)
         files = sorted(d.glob("*.gguf")) if d.is_dir() else []
         if not files:
             return None
-        pub, name = split_model_id(model_id)
+        pub, name = split_model_id(model_id, strict=False)
         return CachedModel(f"{pub}/{name}", pub, name, d, files)
 
     def delete_local(self, model_id: str) -> str:
         """Remove the model directory; returns the deleted dir (the
-        reference replies ``deleted_dir``, nats_llm_studio.go:316-323)."""
-        d = self.model_dir(model_id)
+        reference replies ``deleted_dir``, nats_llm_studio.go:316-323).
+        Lenient validation: legacy-named dirs must stay deletable."""
+        d = self.model_dir(model_id, strict=False)
         if not d.is_dir():
             raise StoreError(f"model directory not found: {d}", dir=str(d))
         shutil.rmtree(d)
@@ -287,6 +313,16 @@ class ModelStore:
                 f"object name {obj_name!r} must be <publisher>/<model>/<file>.gguf"
             )
         fname = parts[-1]
+        # object names are CLIENT-CONTROLLED (any bus client can `nats obj
+        # put` arbitrary names and then ask a worker to pull them): every
+        # component that becomes a filesystem path must pass the strict
+        # creation pattern, or 'a/../../x/f.gguf' would mkdir/write outside
+        # models_dir
+        for comp in parts:
+            if not _SAFE_COMPONENT.match(comp):
+                raise StoreError(
+                    f"unsafe object name component {comp!r} in {obj_name!r}"
+                )
         if model_id:
             dest_dir = self.model_dir(model_id)
         else:

@@ -37,7 +37,9 @@ class _Sub:
     sid: str
     subject: str
     queue: str | None
-    remaining: int | None = None  # auto-unsub countdown
+    delivered: int = 0  # total messages sent to this sid since SUB
+    max_msgs: int | None = None  # auto-unsub bound: TOTAL deliveries since
+    # SUB (real nats-server semantics — NOT a countdown from the UNSUB)
 
 
 class _ClientConn:
@@ -163,11 +165,15 @@ class _ClientConn:
             sub = self.subs.get(ev.sid)
             if sub is None:
                 return
-            if ev.max_msgs is None:
+            if ev.max_msgs is None or sub.delivered >= ev.max_msgs:
+                # immediate unsub, or the bound is already met (UNSUB max is
+                # total deliveries since SUB — a sub that already received
+                # that many must be retired NOW, or a queue group could
+                # route a message to a sid the client has dropped)
                 del self.subs[ev.sid]
                 self.broker._remove_sub(sub)
             else:
-                sub.remaining = ev.max_msgs
+                sub.max_msgs = ev.max_msgs
         elif isinstance(ev, p.CtrlEvent):
             if ev.op == "PING":
                 self.send(p.PONG)
@@ -271,11 +277,10 @@ class EmbeddedBroker:
         targets = plain + [random.choice(members) for members in groups.values()]
         for sub in targets:
             sub.client.send(p.encode_msg(subject, sub.sid, payload, reply, headers))
-            if sub.remaining is not None:
-                sub.remaining -= 1
-                if sub.remaining <= 0:
-                    sub.client.subs.pop(sub.sid, None)
-                    self._remove_sub(sub)
+            sub.delivered += 1
+            if sub.max_msgs is not None and sub.delivered >= sub.max_msgs:
+                sub.client.subs.pop(sub.sid, None)
+                self._remove_sub(sub)
         for pattern, handler in self._internal:
             if subject_matches(pattern, subject):
                 try:

@@ -51,14 +51,23 @@ class Subscription:
         self._cb: Callable[[Msg], Awaitable[None]] | None = None
         self._cb_tasks: set[asyncio.Task] = set()
         self.closed = False
+        self._delivered = 0  # total messages handed to this sub
+        self._max_msgs: int | None = None  # auto-unsub bound, if any
 
     def _deliver(self, msg: Msg) -> None:
+        self._delivered += 1
         if self._cb is not None:
             task = asyncio.ensure_future(self._cb(msg))
             self._cb_tasks.add(task)
             task.add_done_callback(self._cb_tasks.discard)
         else:
             self._queue.put_nowait(msg)
+
+    def _close_local(self) -> None:
+        """Mark closed and wake pending next_msg waiters (no wire traffic)."""
+        if not self.closed:
+            self.closed = True
+            self._queue.put_nowait(None)
 
     async def next_msg(self, timeout: float | None = None) -> Msg:
         if self.closed and self._queue.empty():
@@ -80,9 +89,13 @@ class Subscription:
 
     async def unsubscribe(self) -> None:
         if not self.closed:
-            self.closed = True
+            self._close_local()
             await self._client._unsubscribe(self.sid)
-            self._queue.put_nowait(None)
+
+    async def auto_unsubscribe(self, max_msgs: int) -> None:
+        """UNSUB <sid> <max_msgs>: the server stops after ``max_msgs`` total
+        deliveries to this sid; the client closes the sub at the same count."""
+        await self._client._unsubscribe(self.sid, max_msgs)
 
 
 class NatsClient:
@@ -144,8 +157,7 @@ class NatsClient:
             except (ConnectionError, OSError):
                 pass
         for sub in self._subs.values():
-            sub.closed = True
-            sub._queue.put_nowait(None)
+            sub._close_local()
         for fut in self._resp_futures.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("connection closed"))
@@ -203,7 +215,21 @@ class NatsClient:
         return sub
 
     async def _unsubscribe(self, sid: str, max_msgs: int | None = None) -> None:
-        self._subs.pop(sid, None) if max_msgs is None else None
+        if max_msgs is None:
+            # immediate unsubscribe: the server stops routing now, drop ours
+            self._subs.pop(sid, None)
+        else:
+            # auto-unsub: the SERVER stops after max_msgs total deliveries;
+            # mirror the bound client-side so the sub is closed and removed
+            # when the count is exhausted (see _dispatch) instead of leaking
+            # in _subs forever
+            sub = self._subs.get(sid)
+            if sub is not None:
+                if sub._delivered >= max_msgs:
+                    self._subs.pop(sid, None)
+                    sub._close_local()
+                else:
+                    sub._max_msgs = max_msgs
         try:
             await self._send(p.encode_unsub(sid, max_msgs))
         except ConnectionError:
@@ -314,6 +340,11 @@ class NatsClient:
                         _client=self,
                     )
                 )
+                if sub._max_msgs is not None and sub._delivered >= sub._max_msgs:
+                    # server-side auto-unsub just exhausted: it will send no
+                    # more messages on this sid, so retire the sub locally too
+                    self._subs.pop(sub.sid, None)
+                    sub._close_local()
         elif isinstance(ev, p.CtrlEvent):
             if ev.op == "PING":
                 await self._send(p.PONG)

@@ -1,0 +1,284 @@
+"""Round-3 judge/advisor fixes, pinned by tests.
+
+* VERDICT.md weak #5: a stream ending without the terminal chat.completion
+  aggregate must FAIL LOUDLY (terminal error envelope), never silently
+  regenerate via engine.chat (double cost, possibly different completion).
+* VERDICT.md weak #6: auto-unsub (UNSUB <sid> <max>) bookkeeping — the
+  client must retire the subscription when the server-side count exhausts.
+* ADVICE r3 low: store path components may not end in '.' or ' ' (Windows
+  strips them — two advertised ids would collide on one directory).
+* ADVICE r3 low: EP capacity is per (source-shard, expert); with
+  cf >= E/k no routing skew can drop tokens, so ep>1 == ep=1 exactly.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+
+from conftest import async_test
+from fakes import EchoEngine, FakeRegistry
+
+
+# ---------------------------------------------------------------------------
+# streaming without aggregate -> loud terminal error
+# ---------------------------------------------------------------------------
+
+
+class TruncatedStreamEngine(EchoEngine):
+    """Streams chunks but never the chat.completion aggregate (a broken
+    engine); also counts chat() calls to prove no silent regeneration."""
+
+    def __init__(self, model_id: str):
+        super().__init__(model_id)
+        self.chat_calls = 0
+
+    async def chat(self, payload: dict) -> dict:
+        self.chat_calls += 1
+        return await super().chat(payload)
+
+    async def chat_stream(self, payload: dict):
+        yield {
+            "object": "chat.completion.chunk",
+            "model": self.model_id,
+            "choices": [{"index": 0, "delta": {"content": "partial "}}],
+        }
+        # stream ends here: NO aggregate
+
+
+@async_test
+async def test_stream_without_aggregate_is_terminal_error_not_regeneration():
+    broker = await EmbeddedBroker().start()
+    reg = FakeRegistry(models=["broken"])
+    eng = TruncatedStreamEngine("broken")
+    reg.engines["broken"] = eng
+    worker = Worker(WorkerConfig(nats_url=broker.url), reg)
+    await worker.start()
+    nc = await connect(broker.url)
+    try:
+        body = json.dumps(
+            {"model": "broken", "messages": [{"role": "user", "content": "hi"}],
+             "stream": True}
+        ).encode()
+        msgs = []
+        async for msg in nc.request_stream("lmstudio.chat_model", body, timeout=10.0):
+            msgs.append(msg)
+        # terminal message arrived (stream ended cleanly) and is an ERROR
+        terminal = msgs[-1]
+        assert (terminal.headers or {}).get("Nats-Stream-Done") is not None
+        env = json.loads(terminal.payload)
+        assert env["ok"] is False
+        assert "aggregate" in env["error"]
+        # and the worker did NOT silently regenerate the completion
+        assert eng.chat_calls == 0
+    finally:
+        await nc.close()
+        await worker.drain()
+        await broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# auto-unsub bookkeeping
+# ---------------------------------------------------------------------------
+
+
+@async_test
+async def test_auto_unsubscribe_retires_sub_at_count():
+    broker = await EmbeddedBroker().start()
+    nc = await connect(broker.url)
+    pub = await connect(broker.url)
+    try:
+        sub = await nc.subscribe("auto.test")
+        await sub.auto_unsubscribe(2)
+        for i in range(4):
+            await pub.publish("auto.test", f"m{i}".encode())
+        await pub.flush()
+        got = [await sub.next_msg(timeout=2.0)]
+        got.append(await sub.next_msg(timeout=2.0))
+        assert [m.payload for m in got] == [b"m0", b"m1"]
+        # count exhausted: sub closed and removed from the client's table
+        assert sub.closed
+        assert sub.sid not in nc._subs
+        with pytest.raises(BrokenPipeError):
+            await sub.next_msg(timeout=0.5)
+    finally:
+        await nc.close()
+        await pub.close()
+        await broker.stop()
+
+
+@async_test
+async def test_auto_unsubscribe_after_delivery_retires_immediately():
+    """UNSUB with max <= already-delivered count retires the sub at once."""
+    broker = await EmbeddedBroker().start()
+    nc = await connect(broker.url)
+    pub = await connect(broker.url)
+    try:
+        sub = await nc.subscribe("auto.test2")
+        await pub.publish("auto.test2", b"m0")
+        await pub.flush()
+        assert (await sub.next_msg(timeout=2.0)).payload == b"m0"
+        await sub.auto_unsubscribe(1)  # already delivered 1
+        assert sub.closed
+        assert sub.sid not in nc._subs
+    finally:
+        await nc.close()
+        await pub.close()
+        await broker.stop()
+
+
+@async_test
+async def test_auto_unsub_exhausted_queue_member_not_picked():
+    """Broker side of the same bound: UNSUB max <= delivered retires the
+    queue-group member IMMEDIATELY — otherwise the broker could route a
+    message to a sid the client already dropped and the message would be
+    silently lost to the whole group."""
+    broker = await EmbeddedBroker().start()
+    nc = await connect(broker.url)
+    live = await connect(broker.url)
+    pub = await connect(broker.url)
+    try:
+        # deterministic: `dying` is the only member when "warm" routes
+        dying = await nc.subscribe("qg.test", queue="g")
+        await pub.publish("qg.test", b"warm")
+        await pub.flush()
+        assert (await dying.next_msg(timeout=2.0)).payload == b"warm"
+        survivor = await live.subscribe("qg.test", queue="g")
+        await live.flush()  # survivor's SUB processed before further PUBs
+        # bound already met (delivered=1 >= max=1): the broker must retire
+        # `dying` NOW; every subsequent message goes to the survivor
+        await dying.auto_unsubscribe(1)
+        await nc.flush()  # UNSUB processed by the broker before the PUBs
+        for i in range(4):
+            await pub.publish("qg.test", f"m{i}".encode())
+        await pub.flush()
+        for i in range(4):
+            m = await survivor.next_msg(timeout=2.0)
+            assert m.payload == f"m{i}".encode()
+    finally:
+        await nc.close()
+        await live.close()
+        await pub.close()
+        await broker.stop()
+
+
+# ---------------------------------------------------------------------------
+# path-component hygiene (Windows trailing '.'/' ')
+# ---------------------------------------------------------------------------
+
+
+def test_model_id_components_may_not_end_in_dot_or_space():
+    from nats_llm_studio_tpu.store.manager import StoreError, split_model_id
+
+    assert split_model_id("meta/llama-3-8b") == ("meta", "llama-3-8b")
+    assert split_model_id("a.b c") == ("local", "a.b c")  # interior ok
+    # outer whitespace of the WHOLE id is normalized away before validation
+    assert split_model_id(" model ") == ("local", "model")
+    # trailing '_'/'-' are safe on every platform and must STAY valid:
+    # ids cached by earlier releases must remain listable/deletable
+    assert split_model_id("pub/llama-7b_") == ("pub", "llama-7b_")
+    assert split_model_id("pub-/llama-") == ("pub-", "llama-")
+    # trailing '.'/' ' on a component is rejected for CREATION (Windows
+    # strips them — distinct ids would collide on one directory)
+    for bad in ("model.", "pub./name", "pub /name", "pub/name."):
+        with pytest.raises(StoreError):
+            split_model_id(bad)
+    # ...but the lenient mode (lookup/list/delete of dirs that already
+    # exist) still accepts the legacy charset — same conservative set, no
+    # traversal — so old caches stay reachable
+    assert split_model_id("pub./name", strict=False) == ("pub.", "name")
+    with pytest.raises(StoreError):
+        split_model_id("../etc", strict=False)
+
+
+def test_pull_object_rejects_hostile_object_names(tmp_path):
+    """Object names are client-controlled; components becoming filesystem
+    paths must pass the strict pattern (no traversal, no legacy charset —
+    pulls must not recreate legacy-named dirs on fresh nodes)."""
+    from nats_llm_studio_tpu.store.manager import ModelStore, StoreError
+
+    store = ModelStore(tmp_path, objstore=object())  # validation precedes use
+    for bad in ("a/../x/f.gguf", "pub./model/f.gguf", "pub/model./f.gguf"):
+        with pytest.raises(StoreError):
+            asyncio.run(store._pull_object(bad, None))
+    assert not (tmp_path / "x").exists()
+
+
+def test_legacy_dotted_dir_stays_listable_and_deletable(tmp_path):
+    """A model cached by an earlier release under a now-strict-invalid name
+    (trailing '.') must remain advertised and reclaimable over the bus."""
+    from nats_llm_studio_tpu.store.manager import ModelStore, StoreError
+
+    store = ModelStore(tmp_path)
+    legacy = tmp_path / "pub" / "llama3."
+    legacy.mkdir(parents=True)
+    (legacy / "model.gguf").write_bytes(b"GGUF")
+    ids = [c.model_id for c in store.cached()]
+    assert "pub/llama3." in ids
+    # trailing-SPACE legacy dirs are NOT advertised: the whole-id strip
+    # makes such an id alias its sibling ('pub/llama3 ' -> 'pub/llama3'),
+    # so deleting it would rmtree the WRONG model
+    spacey = tmp_path / "pub" / "llama3 "
+    spacey.mkdir(parents=True)
+    (spacey / "model.gguf").write_bytes(b"GGUF")
+    valid = tmp_path / "pub" / "llama3"
+    valid.mkdir(parents=True)
+    (valid / "model.gguf").write_bytes(b"GGUF")
+    ids2 = [c.model_id for c in store.cached()]
+    assert "pub/llama3 " not in ids2 and "pub/llama3" in ids2
+    assert store.delete_local("pub/llama3 ").endswith("llama3")  # normalized
+    assert spacey.exists() and not valid.exists()
+    deleted = store.delete_local("pub/llama3.")
+    assert deleted.endswith("llama3.")
+    assert not legacy.exists()
+    # creation-side strictness unchanged: import under that id still fails
+    src = tmp_path / "src.gguf"
+    src.write_bytes(b"GGUF")
+    with pytest.raises(StoreError):
+        store.import_file(src, "pub/llama3.")
+
+
+# ---------------------------------------------------------------------------
+# EP capacity: cf >= E/k makes skew drops impossible, ep>1 == ep=1
+# ---------------------------------------------------------------------------
+
+
+def test_ep_skewed_routing_no_drops_at_full_capacity_factor():
+    from nats_llm_studio_tpu.models.config import ModelConfig
+    from nats_llm_studio_tpu.models.llama import init_params
+    from nats_llm_studio_tpu.parallel import build_mesh
+    from nats_llm_studio_tpu.parallel.moe import routed_moe_ffn
+    from nats_llm_studio_tpu.parallel.sharding import shard_params
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    cfg = ModelConfig.tiny(n_experts=8, n_experts_used=2, d_ff=32, n_layers=1)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    p = {k: v[0] for k, v in params["blocks"].items() if k in
+         ("router", "w_gate_e", "w_up_e", "w_down_e")}
+    # force pathological skew: every token routes to experts 0 and 1
+    router = np.zeros(p["router"].shape, np.float32)
+    router[:, 0] = 10.0
+    router[:, 1] = 9.0
+    p = dict(p, router=jnp.asarray(router))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, cfg.d_model), jnp.float32)
+
+    # cf = E/k: per-pair capacity >= all of a shard's assignments -> no
+    # drops possible under ANY skew (documented bound, parallel/moe.py)
+    cf = cfg.n_experts / cfg.n_experts_used
+    want = routed_moe_ffn(x, p, cfg, mesh=None, capacity_factor=cf)
+
+    mesh = build_mesh({"ep": 4}, jax.devices()[:4])
+    sh = shard_params({"blocks": {k: v[None] for k, v in p.items()}}, mesh)["blocks"]
+    p_sh = {k: jax.tree.map(lambda a: a[0], sh[k]) for k in p}
+    got = jax.jit(
+        lambda x, p: routed_moe_ffn(x, p, cfg, mesh=mesh, capacity_factor=cf)
+    )(x, p_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
