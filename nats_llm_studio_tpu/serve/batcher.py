@@ -17,6 +17,7 @@ requests reads them once per B tokens. Design:
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import queue as _queue
 import random
@@ -38,6 +39,19 @@ from ..ops.kvcache import kv_copy_slice, kv_roll_s, kv_slice
 
 log = logging.getLogger(__name__)
 
+# placeholder occupying a slot that a batched chunked admit has reserved but
+# not yet written: decode steps during the chunk loop must neither deliver
+# tokens for it nor let another admit claim the slot
+_RESERVED = object()
+
+
+def _pctl(sorted_vals, q: float) -> float:
+    """Percentile over an ASCENDING-sorted list (0.0 for empty) — the one
+    index rule every reported p50/p95 shares."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
 
 @dataclass
 class _Request:
@@ -48,6 +62,7 @@ class _Request:
     slot: int = -1
     pos: int = 0
     generated: int = 0
+    t_enq: float = 0.0  # monotonic enqueue time (queue-delay metric)
 
     def emit(self, kind: str, value) -> None:
         self.loop.call_soon_threadsafe(self.out.put_nowait, (kind, value))
@@ -60,17 +75,46 @@ class BatcherStats:
     steps: int = 0
     peak_active: int = 0
     grouped_admits: int = 0  # requests admitted via the batched-admit path
+    chunked_group_admits: int = 0  # long prompts admitted via batched chunking
     ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
+    # per-request queue delay (enqueue -> admit DISPATCH), ms — the
+    # scheduling half of TTFT the worker controls (the other half is the
+    # prefill itself). Bounded so a long-lived worker cannot grow it
+    # without limit; bench phases slice copies for per-wave numbers.
+    # Appends happen on the batcher owner thread while health/metrics
+    # handlers snapshot from the asyncio thread — all reads go through
+    # admit_delays() under the lock (deque iteration raises RuntimeError
+    # if a concurrent append interleaves).
+    admit_delays_ms: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=16384)
+    )
+    _delay_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record_admit_delay(self, ms: float) -> None:
+        with self._delay_lock:
+            self.admit_delays_ms.append(ms)
+
+    def admit_delays(self, start: int = 0) -> list[float]:
+        """Thread-safe copy (optionally from index ``start``). NOTE: once
+        the bounded deque has rotated, absolute indices shift — callers
+        slicing by a remembered length must read within one window."""
+        with self._delay_lock:
+            return list(self.admit_delays_ms)[start:]
 
     def snapshot(self) -> dict:
+        d = sorted(self.admit_delays())
         return {
             "requests": self.requests,
             "tokens": self.tokens,
             "decode_steps": self.steps,
             "peak_active_slots": self.peak_active,
             "grouped_admits": self.grouped_admits,
+            "chunked_group_admits": self.chunked_group_admits,
             "ring_compactions": self.ring_compactions,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
+            "admit_queue_delay_p50_ms": round(_pctl(d, 0.5), 1),
+            "admit_queue_delay_p95_ms": round(_pctl(d, 0.95), 1),
+            "admit_queue_delay_max_ms": round(d[-1], 1) if d else 0.0,
         }
 
 
@@ -88,6 +132,8 @@ class ContinuousBatcher:
         prefill_chunk: int = 256,
         decode_burst: int = 8,
         admit_coalesce_ms: float = 3.0,
+        max_group_admit: int = 8,
+        max_group_long: int = 4,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -122,9 +168,19 @@ class ContinuousBatcher:
         # one batched admit dispatch instead of 1 + (m-1)
         self.admit_coalesce_ms = max(0.0, admit_coalesce_ms)
         # cap on one batched admit: bounds the set of compiled admit widths
-        # (mpad in {2,4,8}) and one admit dispatch's latency; a burst of 32
-        # arrivals becomes 4 pipelined [8, bucket] admits, not one [32, *]
-        self.max_group_admit = 8
+        # (mpad in powers of two up to this) and one admit dispatch's
+        # latency. Default 8 favors TTFT at light load; throughput-tuned
+        # deployments raise it (a 96-client wave at 32 is 3 pipelined
+        # [32, bucket] prefills instead of 12 [8, bucket] — bigger MXU
+        # tiles, ~the dominant term in wave ramp time).
+        self.max_group_admit = max(1, max_group_admit)
+        # cap on one batched CHUNKED admit (long prompts): bounds the
+        # [m, L, Hkv, S, D] transient row-cache pair the group prefills
+        # into (HBM: m x 2 full-length rows) and the compiled widths.
+        # Concurrent long prompts otherwise serialize one full chunked
+        # prefill each — B=1 chunks at poor MXU utilization, measured ~4x
+        # the wall time of one [4, C]-chunked pass in the r4 bench.
+        self.max_group_long = max(1, max_group_long)
         self.stats = BatcherStats()
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
@@ -244,6 +300,59 @@ class ContinuousBatcher:
                 seed, temp, topk, topp,
             )
 
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def prefill_chunk_group(params, tokens, km, vm, start, last_pos):
+            """One [m, C] chunk of a BATCHED chunked admit. Donates the
+            m-row transient cache pair (reassigned every iteration; without
+            donation each chunk would briefly hold 2x the m-row caches)."""
+            logits, km, vm = fwd(
+                params, tokens=tokens, k_cache=km, v_cache=vm, start_pos=start,
+                logit_positions=last_pos,
+            )
+            return logits, km, vm
+
+        @jax.jit
+        def select_end(final, logits, is_end):
+            """Keep each row's logits from the chunk its prompt ENDS in."""
+            return jnp.where(is_end[:, None, None], logits, final)
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def finish_admit_group(params, K, V, tok, km, vm, final_logits,
+                               slots, shifts, seeds, temps, topks, topps):
+            """Batched chunked-prefill tail: per-row ring-align + write +
+            first-token sample for m rows in ONE dispatch. km/vm are NOT
+            donated: the AOT compile path double-counts donated buffers
+            against the HBM budget, and the m-row transients are the
+            largest operands here — donating them would spuriously reject
+            configs whose real peak fits comfortably."""
+            m = final_logits.shape[0]
+            lkv, hkv, hd = km.shape[1], km.shape[2], km.shape[4]
+            s_full = km.shape[3]
+            zero = jnp.zeros((), jnp.int32)
+            firsts = sample_rows(
+                final_logits[:, 0], seeds, jnp.zeros((m,), jnp.int32),
+                temps, topks, topps,
+            )
+
+            def body(carry, i):
+                K, V, tok = carry
+                size = (1, lkv, hkv, s_full, hd)
+                k1 = kv_roll_s(kv_slice(km, (i, zero, zero, zero, zero), size),
+                               shifts[i], s_axis=3)
+                v1 = kv_roll_s(kv_slice(vm, (i, zero, zero, zero, zero), size),
+                               shifts[i], s_axis=3)
+                K = kv_copy_slice(K, k1, (slots[i], zero, zero, zero, zero))
+                V = kv_copy_slice(V, v1, (slots[i], zero, zero, zero, zero))
+                tok = jax.lax.dynamic_update_slice(
+                    tok, jax.lax.dynamic_slice_in_dim(firsts, i, 1), (slots[i],)
+                )
+                return (K, V, tok), None
+
+            (K, V, tok), _ = jax.lax.scan(
+                body, (K, V, tok), jnp.arange(m, dtype=jnp.int32)
+            )
+            return firsts, K, V, tok
+
         max_seq = self.max_seq
 
         @partial(jax.jit, donate_argnums=(0, 1))
@@ -285,6 +394,9 @@ class ContinuousBatcher:
         self._admit_fused = admit_fused
         self._admit_many_fused = admit_many_fused
         self._finish_admit = finish_admit
+        self._prefill_chunk_group = prefill_chunk_group
+        self._select_end = select_end
+        self._finish_admit_group = finish_admit_group
         self._decode = decode
         self._compact_ring = compact_ring
 
@@ -330,6 +442,7 @@ class ContinuousBatcher:
             sp=sp,
             loop=asyncio.get_running_loop(),
             out=asyncio.Queue(),
+            t_enq=time.monotonic(),
         )
         with self._submit_lock:
             if self._stopping:
@@ -395,8 +508,6 @@ class ContinuousBatcher:
         return self.max_seq
 
     def _run(self) -> None:
-        import collections
-
         cfg = self.cfg
         B = self.max_slots
         # ring head: the shared cache slot the next decode step writes; rows'
@@ -433,7 +544,13 @@ class ContinuousBatcher:
         inflight: collections.deque = collections.deque()
 
         def active() -> list[int]:
-            return [i for i, r in enumerate(self._slots) if r is not None]
+            # reserved (mid-chunked-admit) slots are excluded: the decode
+            # program still computes their rows (fixed width, masked junk),
+            # but no tokens are delivered and host bookkeeping stays frozen
+            # until the group's finish dispatch writes them
+            return [
+                i for i, r in enumerate(self._slots) if isinstance(r, _Request)
+            ]
 
         def finish_slot(i: int) -> None:
             self._slots[i] = None
@@ -516,11 +633,12 @@ class ContinuousBatcher:
             if not act:
                 return
             if dirty:
+                live = [r if isinstance(r, _Request) else None for r in self._slots]
                 temp = jnp.asarray(
-                    [r.sp.temperature if r else 0.0 for r in self._slots], jnp.float32
+                    [r.sp.temperature if r else 0.0 for r in live], jnp.float32
                 )
-                topk = jnp.asarray([r.sp.top_k if r else 0 for r in self._slots], jnp.int32)
-                topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in self._slots], jnp.float32)
+                topk = jnp.asarray([r.sp.top_k if r else 0 for r in live], jnp.int32)
+                topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in live], jnp.float32)
                 dirty = False
             # cap the burst so no active row can run past the cache capacity.
             # n is a static jit arg: snap to single steps near capacity
@@ -610,6 +728,7 @@ class ContinuousBatcher:
             req.pos = n
             self._slots[slot] = req
             self.stats.requests += 1
+            self.stats.record_admit_delay((time.monotonic() - req.t_enq) * 1e3)
             dirty = True
             host_pos[slot] = n
             host_steps[slot] = 1  # the admit program sampled at rng step 0
@@ -679,17 +798,107 @@ class ContinuousBatcher:
             dirty = True
             self.stats.grouped_admits += len(reqs)
             rows = []
+            t_admit = time.monotonic()
             for j, r in enumerate(reqs):
                 s = slots[j]
                 r.slot = s
                 r.pos = ns[j]
                 self.stats.requests += 1
+                self.stats.record_admit_delay((t_admit - r.t_enq) * 1e3)
                 host_pos[s] = ns[j]
                 host_steps[s] = 1  # the admit program sampled at rng step 0
                 host_seed[s] = seeds[j]
                 rows.append((j, s, r))
             inflight.append(("admit", firsts, rows))
             return True
+
+        def admit_group_chunked(reqs: list[_Request]) -> None:
+            """Admit m LONG prompts (each > prefill_chunk) through SHARED
+            [m, C] chunk dispatches + one batched finish. Serial chunked
+            admits at B=1 leave most of the MXU idle and, worse, make
+            waiting long prompts queue a whole prefill each; batching
+            divides the chunk-pass count by m. A shared decode step still
+            interleaves between chunk dispatches, so live streams' inter-
+            token gap stays bounded by ~one [m, C] chunk.
+
+            Reserved slots hold the _RESERVED placeholder during the loop:
+            the fixed-width decode program computes their rows as masked
+            junk (same as empty slots) and nothing is delivered; the
+            finish dispatch overwrites the full rows and installs the
+            requests atomically."""
+            nonlocal K, V, tok_dev, dirty
+            C = self.prefill_chunk
+            ns = [len(r.prompt_ids) for r in reqs]
+            note_admit(max(ns))
+            slots: list[int] = []
+            try:
+                for r in reqs:
+                    s = self._slots.index(None)
+                    self._slots[s] = _RESERVED
+                    slots.append(s)
+                m = len(reqs)
+                mpad = 1 << (m - 1).bit_length()
+                idx = list(range(m)) + [0] * (mpad - m)  # pad rows repeat row 0
+                seeds = [
+                    r.sp.seed if r.sp.seed is not None else random.getrandbits(31)
+                    for r in reqs
+                ]
+                km, vm = make_cache(cfg, mpad, self.max_seq)
+                final = jnp.zeros((mpad, 1, cfg.vocab_size), jnp.float32)
+                n_chunks = -(-max(ns) // C)
+                end_chunk = [(ns[i] - 1) // C for i in idx]
+                for j in range(n_chunks):
+                    start = j * C
+                    rows = []
+                    for i in idx:
+                        chunk = reqs[i].prompt_ids[start : start + C]
+                        rows.append(chunk + [0] * (C - len(chunk)))
+                    last_pos = [
+                        min(max(ns[i] - 1 - start, 0), C - 1) for i in idx
+                    ]
+                    logits, km, vm = self._prefill_chunk_group(
+                        self.params, jnp.asarray(rows, jnp.int32), km, vm,
+                        jnp.full((mpad,), start, jnp.int32),
+                        jnp.asarray(last_pos, jnp.int32),
+                    )
+                    final = self._select_end(
+                        final, logits,
+                        jnp.asarray([e == j for e in end_chunk], jnp.bool_),
+                    )
+                    if start + C < max(ns):
+                        decode_once()
+                        pump()
+                # shifts AFTER the loop: interleaved decodes moved the head
+                shifts = [(self._ring_next - ns[i]) % self.max_seq for i in idx]
+                firsts, K, V, tok_dev = self._finish_admit_group(
+                    self.params, K, V, tok_dev, km, vm, final,
+                    jnp.asarray([slots[i] for i in idx], jnp.int32),
+                    jnp.asarray(shifts, jnp.int32),
+                    jnp.asarray([seeds[i] for i in idx], jnp.int32),
+                    jnp.asarray([reqs[i].sp.temperature for i in idx], jnp.float32),
+                    jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
+                    jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
+                )
+            except BaseException:
+                for s in slots:  # release reservations; caller emits the error
+                    self._slots[s] = None
+                raise
+            dirty = True
+            self.stats.chunked_group_admits += len(reqs)
+            t_admit = time.monotonic()
+            out_rows = []
+            for j, r in enumerate(reqs):
+                s = slots[j]
+                r.slot = s
+                r.pos = ns[j]
+                self._slots[s] = r
+                self.stats.requests += 1
+                self.stats.record_admit_delay((t_admit - r.t_enq) * 1e3)
+                host_pos[s] = ns[j]
+                host_steps[s] = 1  # the finish program sampled at rng step 0
+                host_seed[s] = seeds[j]
+                out_rows.append((j, s, r))
+            inflight.append(("admit", firsts, out_rows))
 
         def reset_after_failed_dispatch() -> None:
             """A failed admit/decode dispatch may have consumed the donated
@@ -702,8 +911,9 @@ class ContinuousBatcher:
             inflight.clear()
             err = RuntimeError("batcher cache reset after a failed device dispatch")
             for i, r in enumerate(self._slots):
-                if r is not None:
+                if isinstance(r, _Request):
                     r.emit("err", err)
+                if r is not None:  # includes _RESERVED placeholders
                     self._slots[i] = None
                     host_pos[i] = 0
                     host_steps[i] = 0
@@ -757,16 +967,60 @@ class ContinuousBatcher:
                             return
                         waitlist.append(nxt)
             # admit waiters: bursts of short same-bucket prompts go through
-            # one batched dispatch; long/odd ones admit individually
+            # one batched dispatch; runs of LONG prompts go through one
+            # batched CHUNKED dispatch; odd ones admit individually
             while waitlist and None in self._slots:
                 free = self._slots.count(None)
+                head_long = len(waitlist[0].prompt_ids) > self.prefill_chunk
                 head_bucket = (
-                    self._bucket(len(waitlist[0].prompt_ids))
-                    if len(waitlist[0].prompt_ids) <= self.prefill_chunk
-                    else None
+                    None if head_long
+                    else self._bucket(len(waitlist[0].prompt_ids))
                 )
                 group: list[_Request] = []
-                if head_bucket is not None:
+                if head_long:
+                    cap = min(free, self.max_group_long)
+                    while (
+                        waitlist
+                        and len(group) < cap
+                        and len(waitlist[0].prompt_ids) > self.prefill_chunk
+                    ):
+                        group.append(waitlist.pop(0))
+                    # top-up: a chunked admit costs SECONDS of prefill, so
+                    # waiting 50 ms for co-arriving long prompts (e.g. a
+                    # synchronized client wave trickling through the
+                    # broker) is always worth one more group row — the
+                    # arrival race otherwise serializes them into separate
+                    # full prefill passes (and, once, a separate COMPILE
+                    # per distinct group width)
+                    if len(group) < cap and not waitlist and coalesce_s > 0:
+                        deadline = time.monotonic() + max(coalesce_s, 0.05)
+                        while len(group) < cap:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            try:
+                                nxt = self._inbox.get(timeout=left)
+                            except _queue.Empty:
+                                break
+                            if nxt is None:
+                                # shutdown sentinel: push back for the
+                                # outer intake to see after this admit
+                                self._inbox.put(None)
+                                break
+                            if len(nxt.prompt_ids) > self.prefill_chunk:
+                                group.append(nxt)
+                            else:
+                                waitlist.append(nxt)
+                                break
+                    if len(group) > 1:
+                        try:
+                            admit_group_chunked(group)
+                        except Exception as e:  # noqa: BLE001 — surface to callers
+                            for req in group:
+                                req.emit("err", e)
+                            reset_after_failed_dispatch()
+                        continue
+                elif head_bucket is not None:
                     while (
                         waitlist
                         and len(group) < min(free, self.max_group_admit)
@@ -774,7 +1028,7 @@ class ContinuousBatcher:
                         and self._bucket(len(waitlist[0].prompt_ids)) == head_bucket
                     ):
                         group.append(waitlist.pop(0))
-                if len(group) > 1:
+                if len(group) > 1:  # here only via the short same-bucket path
                     try:
                         handled = admit_group(group, head_bucket)
                     except Exception as e:  # noqa: BLE001 — surface to callers
@@ -785,7 +1039,7 @@ class ContinuousBatcher:
                     if handled:
                         continue
                     # group placement would wrap the ring: admit one by one
-                for req in group or [waitlist.pop(0)]:
+                for req in group:
                     try:
                         admit_one(req)
                     except Exception as e:  # noqa: BLE001 — surface to the caller
@@ -824,8 +1078,9 @@ class ContinuousBatcher:
         for req in waitlist:
             req.emit("end", reason)
         for i, req in enumerate(self._slots):
-            if req is not None:
+            if isinstance(req, _Request):
                 req.emit("end", reason)
+            if req is not None:  # includes _RESERVED placeholders
                 self._slots[i] = None
         while True:
             try:

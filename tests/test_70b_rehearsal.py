@@ -1,0 +1,154 @@
+"""70B load-path dress rehearsal (VERDICT r3 missing #3).
+
+BASELINE.md config 3 claims Llama-3-70B fits a v5e-8 with int8 weights; the
+pieces (streaming loader, memory planner) are individually tested, but this
+test exercises the COMBINATION the claim depends on: a split multi-shard
+GGUF with TRUE 70B per-layer geometry (d_model 8192, d_ff 28672, 64 query /
+8 KV heads of dim 128 — the exact Meta-Llama-3-70B block shape) at reduced
+layer count, streamed tensor-by-tensor through ``load_params_sharded`` onto
+the 8-device mesh with ``quant="int8"``, with MEASURED per-device bytes
+checked against ``parallel.memory.estimate_device_bytes`` and extrapolated
+to the full 80-layer model.
+
+Weights are zeros: byte accounting depends on shapes/dtypes only, and zero
+tensors make the multi-GB fixture cheap to write and quantize. The fixture
+ships as Q8_0 (what 70B-class public checkpoints actually use) across two
+shards in the llama.cpp gguf-split layout (mirrors reference capability:
+`lms get` pulls any-size models, nats_llm_studio.go:46-59).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from nats_llm_studio_tpu.gguf import open_gguf
+from nats_llm_studio_tpu.gguf.constants import GGMLType
+from nats_llm_studio_tpu.gguf.writer import GGUFWriter
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.ops.wquant import QTensor
+from nats_llm_studio_tpu.parallel import build_mesh
+from nats_llm_studio_tpu.parallel.loader import load_params_sharded
+from nats_llm_studio_tpu.parallel.memory import estimate_device_bytes
+
+# true Meta-Llama-3-70B block geometry; vocab reduced (embedding table size
+# is linear in vocab and extrapolated separately below), layers reduced 80->2
+D, FF, HQ, HKV, HD = 8192, 28672, 64, 8, 128
+TEST_VOCAB, TEST_L = 2048, 2
+TRUE_VOCAB, TRUE_L = 128256, 80
+
+CFG_TEST = ModelConfig(
+    arch="llama", vocab_size=TEST_VOCAB, d_model=D, n_layers=TEST_L,
+    n_heads=HQ, n_kv_heads=HKV, head_dim=HD, d_ff=FF,
+    rope_theta=500000.0, max_seq_len=8192, dtype="bfloat16",
+)
+CFG_70B = CFG_TEST.with_(vocab_size=TRUE_VOCAB, n_layers=TRUE_L)
+
+
+def _zeros(*shape) -> np.ndarray:
+    return np.zeros(shape, np.float32)
+
+
+def _write_70b_split(tmp_path, n_shards: int = 2):
+    """Emit the shard set directly (per-tensor, no full-tree
+    materialization — the property the real 70B path needs on the writer
+    side too). Shard 1 carries the metadata + embeddings + layer 0;
+    shard 2 carries layer 1."""
+    md = {
+        "general.architecture": "llama",
+        "general.name": "llama70b-rehearsal",
+        "llama.block_count": TEST_L,
+        "llama.embedding_length": D,
+        "llama.attention.head_count": HQ,
+        "llama.attention.head_count_kv": HKV,
+        "llama.attention.key_length": HD,
+        "llama.feed_forward_length": FF,
+        "llama.rope.freq_base": 500000.0,
+        "llama.context_length": 8192,
+        "llama.vocab_size": TEST_VOCAB,
+    }
+    n_tensors = 3 + TEST_L * 9
+
+    def layer_tensors(w: GGUFWriter, i: int) -> None:
+        pre = f"blk.{i}"
+        w.add_tensor(f"{pre}.attn_norm.weight", _zeros(D), GGMLType.F32)
+        w.add_tensor(f"{pre}.ffn_norm.weight", _zeros(D), GGMLType.F32)
+        # stored [out, in] like llama.cpp writes
+        w.add_tensor(f"{pre}.attn_q.weight", _zeros(HQ * HD, D), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.attn_k.weight", _zeros(HKV * HD, D), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.attn_v.weight", _zeros(HKV * HD, D), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.attn_output.weight", _zeros(D, HQ * HD), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.ffn_gate.weight", _zeros(FF, D), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.ffn_up.weight", _zeros(FF, D), GGMLType.Q8_0)
+        w.add_tensor(f"{pre}.ffn_down.weight", _zeros(D, FF), GGMLType.Q8_0)
+
+    paths = []
+    for i in range(n_shards):
+        p = tmp_path / f"llama70b-{i + 1:05d}-of-{n_shards:05d}.gguf"
+        w = GGUFWriter(p)
+        shard_md = dict(md) if i == 0 else {"general.architecture": "llama"}
+        shard_md |= {"split.no": i, "split.count": n_shards,
+                     "split.tensors.count": n_tensors}
+        w.add_dict(shard_md)
+        if i == 0:
+            w.add_tensor("token_embd.weight", _zeros(TEST_VOCAB, D), GGMLType.Q8_0)
+            w.add_tensor("output_norm.weight", _zeros(D), GGMLType.F32)
+            w.add_tensor("output.weight", _zeros(TEST_VOCAB, D), GGMLType.Q8_0)
+        layer_tensors(w, i)
+        w.write()
+        paths.append(p)
+    return paths
+
+
+def _bytes_per_device(params) -> dict[str, int]:
+    """Actual committed bytes per device id, from addressable shards."""
+    out: dict[str, int] = {}
+    for leaf in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QTensor)
+    ):
+        arrays = [leaf.q, leaf.s] if isinstance(leaf, QTensor) else [leaf]
+        for arr in arrays:
+            for sh in arr.addressable_shards:
+                key = str(sh.device)
+                out[key] = out.get(key, 0) + sh.data.nbytes
+    return out
+
+
+def test_70b_split_load_matches_memory_budget(tmp_path):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    paths = _write_70b_split(tmp_path)
+    mesh = build_mesh("tp=8")
+    with open_gguf(paths[0]) as r:  # auto-discovers the sibling shard
+        assert len(r.tensors) == 3 + TEST_L * 9
+        cfg_rt = ModelConfig.from_gguf_metadata(r.metadata).with_(dtype="bfloat16")
+        assert (cfg_rt.d_model, cfg_rt.d_ff, cfg_rt.n_heads, cfg_rt.n_kv_heads) == (
+            D, FF, HQ, HKV,
+        )
+        params = load_params_sharded(r, cfg_rt, mesh, quant="int8")
+
+    per_dev = _bytes_per_device(params)
+    assert len(per_dev) == 8
+    measured = max(per_dev.values())
+    # replicated-vs-sharded asymmetry between devices must be tiny
+    assert max(per_dev.values()) - min(per_dev.values()) < (16 << 20)
+
+    budget = estimate_device_bytes(CFG_TEST, {"tp": 8}, quant="int8")["params"]
+    # the planner must agree with what the loader actually committed
+    assert abs(measured - budget) / budget < 0.05, (measured, budget)
+
+    # --- extrapolate the MEASURED bytes to the full 80-layer, 128k-vocab
+    # model and check the BASELINE config-3 claim: fits 16 GB/chip with
+    # room for cache+workspace ------------------------------------------
+    blocks_bytes = max(_bytes_per_device({"blocks": params["blocks"]}).values())
+    nonlayer_bytes = measured - blocks_bytes
+    per_layer = blocks_bytes / TEST_L
+    # embed + lm_head scale linearly with vocab; out_norm is negligible
+    extrap = nonlayer_bytes * (TRUE_VOCAB / TEST_VOCAB) + TRUE_L * per_layer
+    budget70 = estimate_device_bytes(CFG_70B, {"tp": 8}, quant="int8")["params"]
+    assert abs(extrap - budget70) / budget70 < 0.05, (extrap, budget70)
+    full70 = estimate_device_bytes(
+        CFG_70B, {"tp": 8}, quant="int8", batch=8, seq_len=4096,
+        cache_dtype_bytes=1,
+    )
+    assert full70["total"] < 16 * 2**30, full70  # fits a v5e-8 chip

@@ -220,6 +220,91 @@ async def test_chunked_prefill_interleaves_decode(model):
 
 
 @async_test
+async def test_chunked_group_admit_deterministic(model):
+    """Concurrent LONG prompts (each > prefill_chunk, mixed lengths across
+    chunk boundaries) form ONE batched chunked admit and every stream must
+    match the single-stream reference — pins the per-row end-chunk logit
+    select, per-row ring shifts, and the batched finish."""
+    cfg, params = model
+    prompts = [
+        [(i * 7 + 3) % cfg.vocab_size for i in range(25)],   # 4 chunks
+        [(i * 5 + 1) % cfg.vocab_size for i in range(30)],   # 4 chunks
+        [(i * 3 + 2) % cfg.vocab_size for i in range(17)],   # 3 chunks
+        [(i * 11 + 5) % cfg.vocab_size for i in range(9)],   # 2 chunks
+    ]
+    want = [reference_greedy(cfg, params, p, 5) for p in prompts]
+    b = ContinuousBatcher(
+        params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64],
+        prefill_chunk=8, max_group_long=4,
+    )
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=5)
+            return [t async for t in b.submit(p, sp)]
+
+        tasks = [asyncio.create_task(run(p)) for p in prompts]
+        await asyncio.sleep(0)  # all enqueued before the owner thread starts
+        got = await asyncio.gather(*tasks)
+        assert list(got) == want
+        assert b.stats.chunked_group_admits >= 2, b.stats.snapshot()
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_chunked_group_admit_interleaves_and_spares_live_stream(model):
+    """A batched chunked admit must (a) keep a live stream decoding at
+    chunk boundaries, (b) deliver it NO junk from the reserved rows, and
+    (c) produce reference-exact output for the grouped long prompts even
+    though interleaved decodes moved the ring mid-admit."""
+    cfg, params = model
+    b = ContinuousBatcher(
+        params, cfg, max_slots=3, max_seq_len=64, buckets=[8, 64],
+        prefill_chunk=8, max_group_long=2,
+    )
+    try:
+        events: list[tuple[str, int]] = []
+        sp_a = SamplingParams(temperature=0.0, max_tokens=44)
+
+        async def stream_a():
+            async for t in b.submit([1, 2, 3], sp_a):
+                events.append(("a", t))
+
+        task_a = asyncio.create_task(stream_a())
+        while sum(1 for k, _ in events if k == "a") < 2:
+            await asyncio.sleep(0.01)
+        longs = [
+            [(i * 5 + 1) % cfg.vocab_size for i in range(30)],
+            [(i * 9 + 4) % cfg.vocab_size for i in range(27)],
+        ]
+        want = [reference_greedy(cfg, params, p, 4) for p in longs]
+
+        async def stream_long(tag, p):
+            sp = SamplingParams(temperature=0.0, max_tokens=4)
+            async for t in b.submit(p, sp):
+                events.append((tag, t))
+
+        await asyncio.gather(*(stream_long(f"l{i}", p)
+                               for i, p in enumerate(longs)))
+        await task_a
+        assert b.stats.chunked_group_admits == 2, b.stats.snapshot()
+        # (a) live stream kept flowing during the grouped admit
+        idx_l = next(i for i, (k, _) in enumerate(events) if k.startswith("l"))
+        a_before = sum(1 for k, _ in events[:idx_l] if k == "a")
+        assert a_before >= 4, events
+        # (b)+(c) exact reference outputs — junk delivery or ring
+        # misalignment would break these
+        for i, w in enumerate(want):
+            assert [t for k, t in events if k == f"l{i}"] == w
+        # the live stream's own output is also reference-exact
+        assert [t for k, t in events if k == "a"] == reference_greedy(
+            cfg, params, [1, 2, 3], 44
+        )
+    finally:
+        b.stop()
+
+
+@async_test
 async def test_group_admit_deterministic(model):
     """Force the batched-admission path deterministically: fill the inbox
     BEFORE starting the owner thread so all requests form one group, and
@@ -246,6 +331,34 @@ async def test_group_admit_deterministic(model):
         # the batched path must actually have run — without this the test
         # could silently degrade to admit_one coverage on timing changes
         assert b.stats.grouped_admits >= 2, b.stats.snapshot()
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_wide_group_admit_deterministic(model):
+    """max_group_admit above 8 (throughput-tuned deployments): 16 requests
+    form ONE [16, bucket] fused admit and every stream still matches the
+    single-stream reference; the queue-delay metric records one entry per
+    request."""
+    cfg, params = model
+    prompts = [[i + 1, i + 2, i % 5 + 1] for i in range(16)]
+    want = [reference_greedy(cfg, params, p, 4) for p in prompts]
+    b = ContinuousBatcher(params, cfg, max_slots=16, max_seq_len=64,
+                          buckets=[8, 64], max_group_admit=16)
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=4)
+            return [t async for t in b.submit(p, sp)]
+
+        tasks = [asyncio.create_task(run(p)) for p in prompts]
+        await asyncio.sleep(0)
+        got = await asyncio.gather(*tasks)
+        assert list(got) == want
+        assert b.stats.grouped_admits >= 9, b.stats.snapshot()  # wide path ran
+        assert len(b.stats.admit_delays_ms) == len(prompts)
+        snap = b.stats.snapshot()
+        assert snap["admit_queue_delay_p95_ms"] >= snap["admit_queue_delay_p50_ms"] >= 0.0
     finally:
         b.stop()
 

@@ -1,0 +1,20 @@
+"""Multi-process (DCN-path) smoke as a test artifact (VERDICT r3 #8): the
+`jax.distributed.initialize` path must RUN — two coordinator-connected
+processes, a global mesh spanning both, one cross-process psum, one sharded
+forward. The heavy lifting lives in scripts/dcn_smoke.py (also runnable
+standalone on real multi-host by changing the coordinator address)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_two_process_mesh_psum_and_forward():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "dcn_smoke.py")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DCN_SMOKE PASS" in proc.stdout
