@@ -678,6 +678,9 @@ class ContinuousBatcher:
 
         def admit_one(req: _Request) -> None:
             nonlocal K, V, tok_dev, dirty
+            # queue delay = enqueue -> admission START (the scheduling half
+            # of TTFT); a chunked prefill's seconds are NOT queue delay
+            self.stats.record_admit_delay((time.monotonic() - req.t_enq) * 1e3)
             slot = self._slots.index(None)
             n = len(req.prompt_ids)
             C = self.prefill_chunk
@@ -728,7 +731,6 @@ class ContinuousBatcher:
             req.pos = n
             self._slots[slot] = req
             self.stats.requests += 1
-            self.stats.record_admit_delay((time.monotonic() - req.t_enq) * 1e3)
             dirty = True
             host_pos[slot] = n
             host_steps[slot] = 1  # the admit program sampled at rng step 0
@@ -827,6 +829,11 @@ class ContinuousBatcher:
             finish dispatch overwrites the full rows and installs the
             requests atomically."""
             nonlocal K, V, tok_dev, dirty
+            # queue delay = enqueue -> admission START (scheduling only;
+            # the chunk loop's seconds are prefill, not queueing)
+            t_start = time.monotonic()
+            for r in reqs:
+                self.stats.record_admit_delay((t_start - r.t_enq) * 1e3)
             C = self.prefill_chunk
             ns = [len(r.prompt_ids) for r in reqs]
             note_admit(max(ns))
@@ -885,7 +892,6 @@ class ContinuousBatcher:
                 raise
             dirty = True
             self.stats.chunked_group_admits += len(reqs)
-            t_admit = time.monotonic()
             out_rows = []
             for j, r in enumerate(reqs):
                 s = slots[j]
@@ -893,7 +899,6 @@ class ContinuousBatcher:
                 r.pos = ns[j]
                 self._slots[s] = r
                 self.stats.requests += 1
-                self.stats.record_admit_delay((t_admit - r.t_enq) * 1e3)
                 host_pos[s] = ns[j]
                 host_steps[s] = 1  # the finish program sampled at rng step 0
                 host_seed[s] = seeds[j]
@@ -986,32 +991,57 @@ class ContinuousBatcher:
                     ):
                         group.append(waitlist.pop(0))
                     # top-up: a chunked admit costs SECONDS of prefill, so
-                    # waiting 50 ms for co-arriving long prompts (e.g. a
+                    # waiting ~50 ms for co-arriving long prompts (e.g. a
                     # synchronized client wave trickling through the
                     # broker) is always worth one more group row — the
                     # arrival race otherwise serializes them into separate
                     # full prefill passes (and, once, a separate COMPILE
-                    # per distinct group width)
-                    if len(group) < cap and not waitlist and coalesce_s > 0:
-                        deadline = time.monotonic() + max(coalesce_s, 0.05)
+                    # per distinct group width). With live streams the
+                    # wait is spent as a decode burst instead of idling
+                    # (same wall clock, but the chip works and nobody's
+                    # inter-token gap grows).
+                    def drain_topup() -> bool:
+                        """Pull queued longs; False = stop topping up."""
                         while len(group) < cap:
-                            left = deadline - time.monotonic()
-                            if left <= 0:
-                                break
                             try:
-                                nxt = self._inbox.get(timeout=left)
+                                nxt = self._inbox.get_nowait()
                             except _queue.Empty:
-                                break
+                                return True
                             if nxt is None:
                                 # shutdown sentinel: push back for the
                                 # outer intake to see after this admit
                                 self._inbox.put(None)
-                                break
+                                return False
                             if len(nxt.prompt_ids) > self.prefill_chunk:
                                 group.append(nxt)
                             else:
                                 waitlist.append(nxt)
-                                break
+                                return False
+                        return False
+
+                    if len(group) < cap and not waitlist and coalesce_s > 0:
+                        if active():
+                            decode_once()
+                            pump()
+                            drain_topup()
+                        else:
+                            deadline = time.monotonic() + max(coalesce_s, 0.05)
+                            while len(group) < cap:
+                                left = deadline - time.monotonic()
+                                if left <= 0:
+                                    break
+                                try:
+                                    nxt = self._inbox.get(timeout=left)
+                                except _queue.Empty:
+                                    break
+                                if nxt is None:
+                                    self._inbox.put(None)
+                                    break
+                                if len(nxt.prompt_ids) > self.prefill_chunk:
+                                    group.append(nxt)
+                                else:
+                                    waitlist.append(nxt)
+                                    break
                     if len(group) > 1:
                         try:
                             admit_group_chunked(group)
