@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.flash_attention import flash_attention_auto
+from ..ops.flash_attention import flash_attention_auto, flash_attention_chunk_auto
 from ..ops.kvcache import KVQ, kv_update_slice
 from ..ops.kvcache import is_quantized as kv_is_quantized
 from ..ops.layers import (
@@ -59,6 +59,9 @@ def _attention_block(
     ring_slot: jax.Array | None = None,  # scalar: shared decode write slot
     mesh=None,  # enables the sp ring-attention prefill when the mesh has sp>1
     fresh_prefill: bool = False,  # static: caller guarantees start_pos == 0
+    uniform_start: bool = False,  # static: caller guarantees every row of
+    # start_pos is EQUAL (chunked prefill) — enables the cache-backed flash
+    # continuation kernel instead of the dense [T, S] f32 score fallback
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -200,14 +203,44 @@ def _attention_block(
             # compile-time OOM (16k x 16k f32 = 32 GB)
             out = _fresh_block((q, k, v))
         else:
-            def _dense(ops):
-                q, k, v = ops[0], layer_slice(k_all), layer_slice(v_all)
+            def _dequant_slab(slab, dt):
+                if kv_is_quantized(slab):
+                    return (slab.q.astype(dt) * slab.s[..., None].astype(dt))
+                return slab.astype(dt)
+
+            def _chunk_tileable(dt) -> bool:
+                # mirror of flash_attention_chunk's block_k halving: the
+                # window must divide by SOME power-of-two tile >= the
+                # dtype's sublane multiple, or the kernel raises at trace
+                # time mid-serving (an odd max_seq like 4600 is accepted
+                # by the batcher but only the dense path can serve it)
+                mult = 8 if jnp.dtype(dt).itemsize >= 4 else 16
+                bk = 512
+                while win % bk and bk > mult:
+                    bk //= 2
+                return win % bk == 0
+
+            def _continue(ops):
+                qq = ops[0]
+                if uniform_start and not sp_ring and _chunk_tileable(qq.dtype):
+                    # chunk continuation without the dense [T, win] f32
+                    # score matrix (~1 GB/layer at a 4.6k window — most of
+                    # a chunk's wall time). The KVQ slab dequantizes to a
+                    # bf16 transient (tens of MB), which the kernel then
+                    # streams tile-by-tile; start is a scalar-prefetch
+                    # operand so ONE program serves every chunk offset.
+                    ks = _dequant_slab(layer_slice(k_all), qq.dtype)
+                    vs = _dequant_slab(layer_slice(v_all), qq.dtype)
+                    return flash_attention_chunk_auto(
+                        qq, ks, vs, cfg.attn_scale, start_pos[0]
+                    )
                 return gqa_attention_hmajor(
-                    q, as_attn_operand(k), as_attn_operand(v),
+                    qq, as_attn_operand(layer_slice(k_all)),
+                    as_attn_operand(layer_slice(v_all)),
                     mask[:, :, :win], cfg.attn_scale,
                 )
 
-            out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _dense, (q, k, v))
+            out = jax.lax.cond(jnp.all(start_pos == 0), _fresh_block, _continue, (q, k, v))
     else:
         out = gqa_attention_hmajor(
             q,
@@ -250,6 +283,9 @@ def forward(
     fresh_prefill: bool = False,  # static: start_pos==0 guaranteed; skips
     # compiling the dense fallback branch (whose [B,Hkv,G,T,S] scores are a
     # compile-time OOM at long context)
+    uniform_start: bool = False,  # static: every row of start_pos is EQUAL
+    # (chunked-prefill callers) — the continuation branch then uses the
+    # cache-backed flash kernel instead of the dense [T, win] f32 fallback
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, T, vocab] f32, new k_cache, new v_cache);
     with ``logit_positions`` (per-row prompt-end indices) the logits are
@@ -295,7 +331,7 @@ def forward(
             rms_norm(x, p["attn_norm"], cfg.rms_eps, cfg.norm_plus_one),
             p, cfg, k_all, v_all, layer,
             start_pos, cos, sin, mask, attn_window, allow_flash,
-            ring_slot if t == 1 else None, mesh, fresh_prefill,
+            ring_slot if t == 1 else None, mesh, fresh_prefill, uniform_start,
         )
         x = x + attn_out * cfg.residual_scale
         h = rms_norm(x, p["ffn_norm"], cfg.rms_eps, cfg.norm_plus_one)

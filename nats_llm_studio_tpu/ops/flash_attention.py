@@ -156,3 +156,133 @@ def flash_attention_auto(q, k, v, scale: float) -> jax.Array:
     backend run the same kernel logic through the Pallas interpreter)."""
     interpret = jax.default_backend() != "tpu"
     return flash_attention(q, k, v, scale, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# cache-backed chunk attention (chunked prefill continuation)
+# ---------------------------------------------------------------------------
+
+
+def _flash_chunk_kernel(
+    start_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, block_q: int, block_k: int
+):
+    """One grid step = one (batch, q-head, q-tile, K-TILE) of CHUNK
+    CONTINUATION attention: queries sit at positions [start, start+C) while
+    keys/values are the cache slab [0, KW) — history below ``start`` fully
+    visible, the chunk itself causal, anything above masked. ``start`` is a
+    scalar-prefetch operand, so one compiled program serves every chunk
+    offset (a static start would recompile the 8B program per chunk)."""
+    qt, kt = pl.program_id(2), pl.program_id(3)
+    start = start_ref[0]
+
+    @pl.when(kt == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(kt * block_k <= start + (qt + 1) * block_q - 1)
+    def _compute():
+        q = q_ref[0, 0]  # [BQ, D]
+        k = k_ref[0, 0]  # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = start + qt * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kt * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(kt == pl.num_programs(3) - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret"))
+def flash_attention_chunk(
+    q: jax.Array,  # [B, C, Hq, D] — queries at positions [start, start+C)
+    k: jax.Array,  # [B, Hkv, KW, D] — cache slab (heads-major, as stored)
+    v: jax.Array,
+    scale: float,
+    start: jax.Array,  # int32 scalar, shared by every row (uniform starts)
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Chunked-prefill continuation attention without the [C, KW] f32 score
+    matrix (the dense fallback materializes ~1 GB/layer at a 4.6k window —
+    most of a chunk's wall time). Keys at positions >= start+C (junk beyond
+    the written prefix) are masked by causality since every query position
+    is < start+C. Rows whose prompt is shorter than ``start`` (pad chunks
+    of a batched group admit) produce finite junk that the caller's
+    end-chunk logit select discards."""
+    b, c, hq, d = q.shape
+    hkv, kw = k.shape[1], k.shape[2]
+    group = hq // hkv
+    mult = 8 if q.dtype.itemsize >= 4 else 16
+    block_q = -(-min(block_q, max(c, mult)) // mult) * mult
+    pad_q = (-c) % block_q
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    while kw % block_k and block_k > mult:
+        block_k //= 2
+    if kw % block_k:
+        raise ValueError(f"cache window {kw} not tileable by {block_k}")
+    qh = q.transpose(0, 2, 1, 3)  # [B, Hq, Cp, D]
+
+    def q_map(bi, hi, qi, ki, start_ref):
+        return (bi, hi, qi, 0)
+
+    def kv_map(bi, hi, qi, ki, start_ref, g=group):
+        # causal revisit-skip: tiles past the last live key tile for this
+        # q tile remap to it (their DMA is elided by the pipeline)
+        live = (start_ref[0] + (qi + 1) * block_q - 1) // block_k
+        return (bi, hi // g, jnp.minimum(ki, live), 0)
+
+    grid = (b, hq, qh.shape[2] // block_q, kw // block_k)
+    kernel = functools.partial(
+        _flash_chunk_kernel, scale=scale, block_q=block_q, block_k=block_k
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.reshape(start, (1,)).astype(jnp.int32), qh, k, v)
+    return out.transpose(0, 2, 1, 3)[:, :c]
+
+
+def flash_attention_chunk_auto(q, k, v, scale: float, start) -> jax.Array:
+    interpret = jax.default_backend() != "tpu"
+    return flash_attention_chunk(q, k, v, scale, start, interpret=interpret)

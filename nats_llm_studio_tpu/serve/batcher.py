@@ -188,10 +188,12 @@ class ContinuousBatcher:
         @jax.jit
         def prefill1(params, tokens, k1, v1, start, last_pos):
             # lm_head at one position only ([1,1,vocab]); non-final chunks
-            # ignore the logits, the final chunk's last_pos is the prompt end
+            # ignore the logits, the final chunk's last_pos is the prompt end.
+            # uniform_start: all rows share `start`, so chunk continuations
+            # ride the cache-backed flash kernel, not the dense fallback
             logits, k1, v1 = fwd(
                 params, tokens=tokens, k_cache=k1, v_cache=v1, start_pos=start,
-                logit_positions=last_pos,
+                logit_positions=last_pos, uniform_start=True,
             )
             return logits, k1, v1
 
@@ -307,7 +309,7 @@ class ContinuousBatcher:
             donation each chunk would briefly hold 2x the m-row caches)."""
             logits, km, vm = fwd(
                 params, tokens=tokens, k_cache=km, v_cache=vm, start_pos=start,
-                logit_positions=last_pos,
+                logit_positions=last_pos, uniform_start=True,
             )
             return logits, km, vm
 

@@ -220,6 +220,35 @@ async def test_chunked_prefill_interleaves_decode(model):
 
 
 @async_test
+async def test_chunked_prefill_flash_continuation_matches(model):
+    """With use_flash_attention on, chunk continuations ride the
+    cache-backed flash kernel (interpret mode on CPU) — output must still
+    match the dense single-stream reference exactly."""
+    cfg, params = model
+    fcfg = cfg.with_(use_flash_attention=True)
+    prompts = [
+        [(i * 7 + 3) % cfg.vocab_size for i in range(25)],
+        [(i * 5 + 1) % cfg.vocab_size for i in range(30)],
+    ]
+    want = [reference_greedy(cfg, params, p, 5) for p in prompts]
+    b = ContinuousBatcher(
+        params, fcfg, max_slots=2, max_seq_len=64, buckets=[8, 64],
+        prefill_chunk=8, max_group_long=2,
+    )
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=5)
+            return [t async for t in b.submit(p, sp)]
+
+        tasks = [asyncio.create_task(run(p)) for p in prompts]
+        await asyncio.sleep(0)
+        got = await asyncio.gather(*tasks)
+        assert list(got) == want
+    finally:
+        b.stop()
+
+
+@async_test
 async def test_chunked_group_admit_deterministic(model):
     """Concurrent LONG prompts (each > prefill_chunk, mixed lengths across
     chunk boundaries) form ONE batched chunked admit and every stream must

@@ -61,3 +61,90 @@ def test_model_forward_with_flash_matches_dense():
     got, k_got, _ = forward(params, cfg_f, tokens, k, v, jnp.zeros((1,), jnp.int32))
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=5e-3, atol=5e-3)
     np.testing.assert_allclose(np.asarray(k_got), np.asarray(k_ref), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# cache-backed chunk attention (chunked-prefill continuation)
+# ---------------------------------------------------------------------------
+
+
+def _reference_chunk(q, k_slab, v_slab, scale, start):
+    """Dense reference: queries at [start, start+C) over the cache slab
+    (history visible, chunk causal, beyond masked). k/v heads-major."""
+    b, c, hq, d = q.shape
+    kw = k_slab.shape[2]
+    q_pos = start + jnp.arange(c)
+    k_pos = jnp.arange(kw)
+    mask = (k_pos[None, None, :] <= q_pos[None, :, None]).repeat(b, axis=0)
+    return gqa_attention_hmajor(q, k_slab, v_slab, mask, scale)
+
+
+@pytest.mark.parametrize(
+    "b,c,kw,start,hq,hkv,d,bq,bk",
+    [
+        (1, 16, 64, 0, 4, 4, 32, 16, 16),    # first chunk (pure causal)
+        (1, 16, 64, 16, 4, 2, 16, 16, 16),   # mid chunk with history
+        (2, 16, 64, 48, 8, 2, 16, 16, 16),   # last chunk, GQA group 4
+        (1, 24, 96, 40, 4, 2, 16, 16, 16),   # unaligned start vs tiles
+        (2, 16, 64, 32, 4, 4, 16, 64, 128),  # blocks larger than shapes
+    ],
+)
+def test_flash_chunk_matches_reference(b, c, kw, start, hq, hkv, d, bq, bk):
+    from nats_llm_studio_tpu.ops.flash_attention import flash_attention_chunk
+
+    kq, kk, kv = jax.random.split(RNG, 3)
+    q = jax.random.normal(kq, (b, c, hq, d), jnp.float32)
+    k_slab = jax.random.normal(kk, (b, hkv, kw, d), jnp.float32)
+    v_slab = jax.random.normal(kv, (b, hkv, kw, d), jnp.float32)
+    scale = d**-0.5
+    want = _reference_chunk(q, k_slab, v_slab, scale, start)
+    got = flash_attention_chunk(
+        q, k_slab, v_slab, scale, jnp.int32(start), block_q=bq, block_k=bk,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_chunk_one_program_all_starts():
+    """The same compiled program must serve every chunk offset (start is a
+    traced scalar-prefetch operand, not a static arg)."""
+    from nats_llm_studio_tpu.ops.flash_attention import flash_attention_chunk
+
+    kq, kk, kv = jax.random.split(RNG, 3)
+    b, c, kw, hq, hkv, d = 1, 16, 64, 4, 2, 16
+    q = jax.random.normal(kq, (b, c, hq, d), jnp.float32)
+    k_slab = jax.random.normal(kk, (b, hkv, kw, d), jnp.float32)
+    v_slab = jax.random.normal(kv, (b, hkv, kw, d), jnp.float32)
+    scale = d**-0.5
+    for start in (0, 16, 32, 48):
+        want = _reference_chunk(q, k_slab, v_slab, scale, start)
+        got = flash_attention_chunk(
+            q, k_slab, v_slab, scale, jnp.int32(start), block_q=16,
+            block_k=16, interpret=True,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_chunk_continuation_untileable_window_falls_back_dense():
+    """A cache window only 8-aligned (e.g. 88) cannot tile for bf16 — the
+    model must fall back to the dense path instead of raising at trace
+    time mid-serving (review r4 finding)."""
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=88, dtype="bfloat16",
+                           use_flash_attention=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    k, v = make_cache(cfg, 1, 88)
+    # first chunk at start 0, then a continuation at start 4 — the branch
+    # that would hit flash_attention_chunk's tiling ValueError
+    logits, k, v = forward(params, cfg, tokens, k, v,
+                           jnp.zeros((1,), jnp.int32), uniform_start=True)
+    logits2, k, v = forward(params, cfg, tokens, k, v,
+                            jnp.full((1,), 4, jnp.int32), uniform_start=True)
+    # dense reference on a plain config
+    cfg_d = cfg.with_(use_flash_attention=False)
+    kd, vd = make_cache(cfg_d, 1, 88)
+    ref1, kd, vd = forward(params, cfg_d, tokens, kd, vd, jnp.zeros((1,), jnp.int32))
+    ref2, kd, vd = forward(params, cfg_d, tokens, kd, vd, jnp.full((1,), 4, jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits2), np.asarray(ref2),
+                               rtol=2e-2, atol=2e-2)
