@@ -275,6 +275,44 @@ def moe_bench(cfg=None, batch=32, prompt_len=128, seq_len=512,
     out["routed_prefill_speedup"] = round(
         out["dense"]["prefill_s"] / out["routed"]["prefill_s"], 3
     )
+
+    # deep-prefill ablation: at batch*512 tokens the expert FLOPs dominate
+    # everything else, so the E/k = 4x dense dispatch waste is maximally
+    # visible — the number that justifies the routed path's existence
+    long_t = int(os.environ.get("BENCH_MOE_PREFILL", "512"))
+    ab = {}
+    for nm, routed in (("routed", True), ("dense", False)):
+        cfg_i = base.with_(use_routed_moe=routed)
+        fwd = partial(forward, cfg=cfg_i)
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def pre(params, tokens, k, v):
+            logits, k, v = fwd(
+                params, tokens=tokens, k_cache=k, v_cache=v,
+                start_pos=jnp.zeros((tokens.shape[0],), jnp.int32),
+                logit_positions=jnp.full((tokens.shape[0],), tokens.shape[1] - 1,
+                                         jnp.int32),
+                fresh_prefill=True,
+            )
+            return logits, k, v
+
+        toks = jnp.ones((batch, long_t), jnp.int32)
+        k, v = make_cache(base, batch, long_t)
+        logits, k, v = pre(params, toks, k, v)
+        _sync(logits)
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            logits, k, v = pre(params, toks, k, v)
+            _sync(logits)
+            best = min(best, time.perf_counter() - t0)
+        ab[nm] = round(best, 4)
+        del k, v, logits
+        gc.collect()
+    out["prefill_deep"] = {
+        "tokens": batch * long_t, **ab,
+        "routed_speedup": round(ab["dense"] / ab["routed"], 3),
+    }
     del params
     gc.collect()
     return out
@@ -773,32 +811,47 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
                               wave_body)
     gc.collect()
 
-    xl_seq = int(os.environ.get("BENCH_XL_SEQ", "8192"))
-    xl_batcher = ContinuousBatcher(
-        params, cfg, max_slots=2, max_seq_len=xl_seq,
-        buckets=[b for b in (512, 2048) if b < xl_seq] + [xl_seq],
-        prefill_chunk=1024,
-    )
+    def xl_point(xl_seq: int, n_tokens: int) -> dict:
+        """One N-token prompt served alone on a 2-slot engine with an
+        xl_seq ring (2 slots x 16k int8 KV ~ 2.2 GB next to 8.7 GB int8
+        weights — inside the AOT double-count budget). The model config's
+        context length is raised to the ring size: ContinuousBatcher clamps
+        max_seq_len to cfg.max_seq_len, which silently rejected 16k prompts
+        on the 8k-configured 8B geometry."""
+        xl_cfg = cfg.with_(max_seq_len=max(cfg.max_seq_len, xl_seq))
+        xl_batcher = ContinuousBatcher(
+            params, xl_cfg, max_slots=2, max_seq_len=xl_seq,
+            buckets=[b for b in (512, 2048) if b < xl_seq] + [xl_seq],
+            prefill_chunk=1024,
+        )
 
-    async def xl_body(nc, one_chat):
-        await one_chat(0, make_long_prompt(1536), 8)  # warm chunk+admit+decode
-        xl = await one_chat(500, make_long_prompt(xl_tokens), 32)
-        return {
-            "prompt_tokens": xl["prompt_tokens"],
-            "ttft_ms": round(xl["ttft_s"] * 1e3, 1),
-            "prefill_tok_s": (
-                round(xl["prompt_tokens"] / xl["ttft_s"], 1)
-                if xl["ttft_s"] == xl["ttft_s"] and xl["ttft_s"] > 0 else 0.0
-            ),
-            "completion_tokens": xl["completion_tokens"],
-            "parse_fail": xl["parse_fail"],
-            "max_seq_len": xl_seq,
-        }
+        async def xl_body(nc, one_chat):
+            await one_chat(0, make_long_prompt(1536), 8)  # warm chunk+admit+decode
+            xl = await one_chat(500, make_long_prompt(n_tokens), 32)
+            return {
+                "prompt_tokens": xl["prompt_tokens"],
+                "ttft_ms": round(xl["ttft_s"] * 1e3, 1),
+                "prefill_tok_s": (
+                    round(xl["prompt_tokens"] / xl["ttft_s"], 1)
+                    if xl["ttft_s"] == xl["ttft_s"] and xl["ttft_s"] > 0 else 0.0
+                ),
+                "completion_tokens": xl["completion_tokens"],
+                "parse_fail": xl["parse_fail"],
+                "max_seq_len": xl_seq,
+            }
 
-    xl_single = _drive_engine(cfg, params, model_id, tokenizer, xl_batcher,
-                              xl_body)
-    gc.collect()
-    return {"long_wave": long_wave, "xl_single": xl_single}
+        out = _drive_engine(xl_cfg, params, model_id, tokenizer, xl_batcher,
+                            xl_body)
+        gc.collect()
+        return out
+
+    xl_single = xl_point(int(os.environ.get("BENCH_XL_SEQ", "8192")), xl_tokens)
+    result = {"long_wave": long_wave, "xl_single": xl_single}
+    # the 16k-class point: the same context length long_prefill proves
+    # on-device, SERVED through chat_model (skipped for env-shrunk smokes)
+    if os.environ.get("BENCH_XL16", "1") != "0" and wave_seq >= 4608:
+        result["xl16_single"] = xl_point(16384, 15872)
+    return result
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,8 @@ def test_moe_bench_smoke():
     assert out["routed_decode_speedup"] > 0
     assert out["routed_prefill_speedup"] > 0
     assert out["geometry"]["n_experts"] == 4
+    assert out["prefill_deep"]["routed"] > 0 and out["prefill_deep"]["dense"] > 0
+    assert out["prefill_deep"]["routed_speedup"] > 0
 
 
 def test_e2e_long_context_bench_smoke(monkeypatch):
