@@ -54,6 +54,23 @@ class WorkerConfig:
     max_url_pull_bytes: int = field(
         default_factory=lambda: int(_env("MAX_URL_PULL_BYTES", str(100 << 30)))
     )
+    # overload bounds on the batcher admit queue (0 disables either).
+    # Depth: chat_model sheds immediately past this many queued requests.
+    # Age: waiters older than this are shed at admit time. Shedding replies
+    # with an honest error envelope so queue-group peers absorb the overflow
+    # (/root/reference/README.md:478-484); without bounds the r4 bench
+    # measured 38.6 s of silent queueing. Unset ADMIT_QUEUE_LIMIT derives
+    # 4 x MAX_BATCH_SLOTS; an explicit 0 disables the depth bound.
+    admit_queue_limit: int = field(
+        default_factory=lambda: int(_env("ADMIT_QUEUE_LIMIT", "-1"))
+    )
+    admit_max_age_ms: float = field(
+        default_factory=lambda: float(_env("ADMIT_MAX_AGE_MS", "30000"))
+    )
+
+    def __post_init__(self) -> None:
+        if self.admit_queue_limit < 0:  # unset: scale with the slot count
+            self.admit_queue_limit = 4 * self.max_batch_slots
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

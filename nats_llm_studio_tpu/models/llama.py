@@ -22,7 +22,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.flash_attention import flash_attention_auto, flash_attention_chunk_auto
+from ..ops.flash_attention import (
+    flash_attention_auto,
+    flash_attention_chunk_auto,
+    flash_attention_chunk_kvq_auto,
+)
 from ..ops.kvcache import KVQ, kv_update_slice
 from ..ops.kvcache import is_quantized as kv_is_quantized
 from ..ops.layers import (
@@ -203,39 +207,45 @@ def _attention_block(
             # compile-time OOM (16k x 16k f32 = 32 GB)
             out = _fresh_block((q, k, v))
         else:
-            def _dequant_slab(slab, dt):
-                if kv_is_quantized(slab):
-                    return (slab.q.astype(dt) * slab.s[..., None].astype(dt))
-                return slab.astype(dt)
-
-            def _chunk_tileable(dt) -> bool:
-                # mirror of flash_attention_chunk's block_k halving: the
-                # window must divide by SOME power-of-two tile >= the
-                # dtype's sublane multiple, or the kernel raises at trace
-                # time mid-serving (an odd max_seq like 4600 is accepted
-                # by the batcher but only the dense path can serve it)
-                mult = 8 if jnp.dtype(dt).itemsize >= 4 else 16
+            def _chunk_tileable(dt, quantized: bool) -> bool:
+                # mirror of the chunk kernels' block_k halving: the window
+                # must divide by SOME power-of-two tile >= the operand's
+                # sublane multiple (int8 codes need 32 rows), or the kernel
+                # raises at trace time mid-serving (an odd max_seq like
+                # 4600 is accepted by the batcher but only the dense path
+                # can serve it)
+                mult = 32 if quantized else (8 if jnp.dtype(dt).itemsize >= 4 else 16)
                 bk = 512
                 while win % bk and bk > mult:
                     bk //= 2
                 return win % bk == 0
 
             def _continue(ops):
+                # chunk continuation without the dense [T, win] f32 score
+                # matrix (~1 GB/layer at a 4.6k window — most of a chunk's
+                # wall time); start is a scalar-prefetch operand so ONE
+                # program serves every chunk offset at a given window.
                 qq = ops[0]
-                if uniform_start and not sp_ring and _chunk_tileable(qq.dtype):
-                    # chunk continuation without the dense [T, win] f32
-                    # score matrix (~1 GB/layer at a 4.6k window — most of
-                    # a chunk's wall time). The KVQ slab dequantizes to a
-                    # bf16 transient (tens of MB), which the kernel then
-                    # streams tile-by-tile; start is a scalar-prefetch
-                    # operand so ONE program serves every chunk offset.
-                    ks = _dequant_slab(layer_slice(k_all), qq.dtype)
-                    vs = _dequant_slab(layer_slice(v_all), qq.dtype)
+                k_sl = layer_slice(k_all)
+                quantized = kv_is_quantized(k_sl)
+                if uniform_start and not sp_ring and _chunk_tileable(qq.dtype, quantized):
+                    v_sl = layer_slice(v_all)
+                    if quantized:
+                        # int8 KV: codes + scales stream straight into the
+                        # kernel and dequantize per tile IN VMEM — half the
+                        # HBM bytes of a bf16 slab and, decisively, no
+                        # full-window dequant transient per layer per chunk
+                        # (the r4 O(T^2) long-context prefill tail)
+                        return flash_attention_chunk_kvq_auto(
+                            qq, k_sl.q, k_sl.s, v_sl.q, v_sl.s,
+                            cfg.attn_scale, start_pos[0]
+                        )
                     return flash_attention_chunk_auto(
-                        qq, ks, vs, cfg.attn_scale, start_pos[0]
+                        qq, k_sl.astype(qq.dtype), v_sl.astype(qq.dtype),
+                        cfg.attn_scale, start_pos[0]
                     )
                 return gqa_attention_hmajor(
-                    qq, as_attn_operand(layer_slice(k_all)),
+                    qq, as_attn_operand(k_sl),
                     as_attn_operand(layer_slice(v_all)),
                     mask[:, :, :win], cfg.attn_scale,
                 )

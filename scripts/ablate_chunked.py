@@ -1,0 +1,131 @@
+"""On-chip ablation: where does chunked-prefill time go at 16k?
+
+Compares, on the same int8-weight 8B geometry with int8 KV:
+  single  — one fresh-prefill flash dispatch over [1, T]
+  scan    — prefill_scan-style: lax.scan over G chunks per dispatch
+  chunks  — one dispatch per [1, C] chunk (the live-stream interleave path)
+
+Usage: python scripts/ablate_chunked.py [T] [C] [G]
+"""
+
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import LLAMA3_8B, init_params_int8, _sync
+from nats_llm_studio_tpu.models.llama import forward, make_cache
+
+T = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+C = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+G = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+cfg = LLAMA3_8B.with_(max_seq_len=T, use_flash_attention=True,
+                      decode_unroll=True, kv_quant="int8")
+params = init_params_int8(cfg)
+fwd = partial(forward, cfg=cfg)
+n_chunks = T // C
+
+
+@partial(jax.jit, donate_argnums=(2, 3))
+def single(params, tokens, k, v):
+    logits, k, v = fwd(params, tokens=tokens, k_cache=k, v_cache=v,
+                       start_pos=jnp.zeros((1,), jnp.int32),
+                       logit_positions=jnp.full((1,), T - 1, jnp.int32),
+                       fresh_prefill=True)
+    return logits, k, v
+
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def scan_group(params, k1, v1, tokens, n, j0):
+    final0 = jnp.zeros((1, 1, cfg.vocab_size), jnp.float32)
+
+    def body(carry, inp):
+        k1, v1, final = carry
+        toks, j = inp
+        start = j * C
+        logits, k1, v1 = fwd(params, tokens=toks, k_cache=k1, v_cache=v1,
+                             start_pos=jnp.full((1,), start, jnp.int32),
+                             logit_positions=jnp.clip(n - 1 - start, 0, C - 1)[None],
+                             uniform_start=True)
+        final = jnp.where((n - 1) // C == j, logits, final)
+        return (k1, v1, final), None
+
+    (k1, v1, final), _ = jax.lax.scan(
+        body, (k1, v1, final0),
+        (tokens, j0 + jnp.arange(tokens.shape[0], dtype=jnp.int32)))
+    return final, k1, v1
+
+
+@partial(jax.jit, donate_argnums=(2, 3), static_argnums=(6,))
+def one_chunk(params, tokens, k1, v1, start, last_pos, window):
+    logits, k1, v1 = fwd(params, tokens=tokens, k_cache=k1, v_cache=v1,
+                         start_pos=start, logit_positions=last_pos,
+                         uniform_start=True, attn_window=window)
+    return logits, k1, v1
+
+
+def timed(fn, reps=2):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def win_bucket(x):
+    w = 1 << max(0, x - 1).bit_length()
+    return min(w, T)
+
+
+tokens = jnp.ones((1, T), jnp.int32)
+
+# single fresh dispatch
+k, v = make_cache(cfg, 1, T)
+logits, k, v = single(params, tokens, k, v)
+_sync(logits)
+
+def run_single():
+    global k, v
+    logits, k, v = single(params, tokens, k, v)
+    _sync(logits)
+
+t_single = timed(run_single)
+print(f"single : {t_single:.3f}s  {T / t_single:,.0f} tok/s")
+
+# scan-grouped
+tok_g = jnp.ones((G, 1, C), jnp.int32)
+def run_scan():
+    k1, v1 = make_cache(cfg, 1, T)
+    logits = None
+    for j0 in range(0, n_chunks, G):
+        logits, k1, v1 = scan_group(params, k1, v1, tok_g, jnp.int32(T), jnp.int32(j0))
+    _sync(logits)
+
+run_scan()  # compile
+t_scan = timed(run_scan)
+print(f"scan{G:>3}: {t_scan:.3f}s  {T / t_scan:,.0f} tok/s  ({n_chunks // G} dispatches)")
+
+# per-chunk dispatches (pow2 windows)
+tok_c = jnp.ones((1, C), jnp.int32)
+wins = sorted({win_bucket(s + C) for s in range(0, T, C)})
+def run_chunks():
+    k1, v1 = make_cache(cfg, 1, T)
+    logits = None
+    for j in range(n_chunks):
+        start = j * C
+        logits, k1, v1 = one_chunk(
+            params, tok_c, k1, v1, jnp.full((1,), start, jnp.int32),
+            jnp.full((1,), C - 1, jnp.int32), win_bucket(start + C))
+    _sync(logits)
+
+run_chunks()  # compile all windows
+t_chunks = timed(run_chunks)
+print(f"chunks : {t_chunks:.3f}s  {T / t_chunks:,.0f} tok/s  ({n_chunks} dispatches)")

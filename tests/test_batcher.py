@@ -438,3 +438,48 @@ async def test_ring_wrap_compaction_restores_windows(model):
         assert got_short == want_short
     finally:
         b.stop()
+
+
+@async_test
+async def test_idle_full_prefill_matches(model):
+    """An idle engine admits a long prompt through prefill_full (one fresh
+    dispatch at a pow2 token bucket, right-padded) instead of chunking.
+    Output must equal the single-stream reference at several lengths
+    straddling bucket edges, and a FOLLOWING admit while the first stream
+    decodes must still be correct (the rolled-in pad junk above n lands on
+    future ring slots decode overwrites — never in any validity window).
+    Flash is on (interpret-mode kernels on CPU): the shortcut is gated on
+    the fresh-flash path, since the dense fallback's [Hq, bucket, S] score
+    matrix is exactly what chunking exists to bound."""
+    cfg, params = model
+    fcfg = cfg.with_(use_flash_attention=True)
+    b = ContinuousBatcher(
+        params, fcfg, max_slots=2, max_seq_len=64, buckets=[8, 64], prefill_chunk=4
+    )
+    try:
+        for ln in (5, 9, 31, 38):  # bucket edges: 8|16|32|64
+            p = [(i * 7 + 3 + ln) % cfg.vocab_size for i in range(ln)]
+            want = reference_greedy(cfg, params, p, 5)
+            sp = SamplingParams(temperature=0.0, max_tokens=5)
+            got = [t async for t in b.submit(p, sp)]
+            assert got == want, (ln, got, want)
+        # pad-junk check: long idle admit, then a joiner decodes alongside
+        p1 = [(i * 5 + 1) % cfg.vocab_size for i in range(21)]  # bucket 32
+        p2 = [4, 5, 6]
+        want1 = reference_greedy(cfg, params, p1, 16)
+        want2 = reference_greedy(cfg, params, p2, 8)
+        got1: list[int] = []
+
+        async def first():
+            async for t in b.submit(p1, SamplingParams(temperature=0.0, max_tokens=16)):
+                got1.append(t)
+
+        t1 = asyncio.create_task(first())
+        while len(got1) < 2:
+            await asyncio.sleep(0.01)
+        got2 = [t async for t in b.submit(p2, SamplingParams(temperature=0.0, max_tokens=8))]
+        await t1
+        assert got1 == want1
+        assert got2 == want2
+    finally:
+        b.stop()

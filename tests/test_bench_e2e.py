@@ -27,6 +27,16 @@ def test_e2e_nats_bench_smoke():
     assert out["throughput_wave"]["parse_failures"] == 0
     assert "tokens_per_step_avg" in out["throughput_wave"]["batcher_phase"]
     assert "admit_queue_delay_p95_ms" in out["throughput_wave"]["batcher_phase"]
+    # round-5 phases: ring-compaction recovery + bounded-overload shedding
+    ring = out["ring_compaction"]
+    assert ring["parse_failures"] == 0
+    assert {"ring_compactions", "survivor_gap_post_roll_p50_ms"} <= set(ring)
+    ov = out["overload"]
+    assert ov["completed"] >= 1
+    assert "admit_queue_delay_p95_ms" in ov["batcher_phase"]
+    assert "batcher_shed_total" in ov and "sheds_observed_by_clients" in ov
+    # bounds were restored after the overload phase
+    assert "shed" in out["batcher"] and "cancelled" in out["batcher"]
 
 
 def test_moe_bench_smoke():
@@ -46,6 +56,11 @@ def test_moe_bench_smoke():
     assert out["geometry"]["n_experts"] == 4
     assert out["prefill_deep"]["routed"] > 0 and out["prefill_deep"]["dense"] > 0
     assert out["prefill_deep"]["routed_speedup"] > 0
+    # round-5: small-batch ablation + measured capacity-overflow drop rates
+    small = out["small_batch"]
+    assert small["b1"]["routed_tok_s"] > 0 and small["b4"]["dense_tok_s"] > 0
+    assert 0.0 <= small["drop_fraction"]["decode_b1"] <= 1.0
+    assert "prefill_4x128" in small["drop_fraction"]
 
 
 def test_e2e_long_context_bench_smoke(monkeypatch):

@@ -126,6 +126,40 @@ def test_flash_chunk_one_program_all_starts():
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "b,c,kw,start,hq,hkv,d,bq,bk",
+    [
+        (1, 16, 64, 16, 4, 2, 16, 16, 32),   # mid chunk with history, GQA 2
+        (2, 16, 64, 48, 8, 2, 16, 16, 32),   # last chunk, GQA group 4
+        (1, 24, 96, 40, 4, 2, 16, 16, 32),   # unaligned start vs tiles
+    ],
+)
+def test_flash_chunk_kvq_matches_dequantized_reference(b, c, kw, start, hq,
+                                                       hkv, d, bq, bk):
+    """The int8-KV chunk kernel (per-tile VMEM dequant) must match the
+    dense reference computed over the explicitly dequantized slab — the
+    serving path's math, minus the full-window HBM transient."""
+    from nats_llm_studio_tpu.ops.flash_attention import flash_attention_chunk_kvq
+    from nats_llm_studio_tpu.ops.kvcache import quantize_rows
+
+    kq_, kk, kv = jax.random.split(RNG, 3)
+    q = jax.random.normal(kq_, (b, c, hq, d), jnp.float32)
+    k_slab = jax.random.normal(kk, (b, hkv, kw, d), jnp.float32)
+    v_slab = jax.random.normal(kv, (b, hkv, kw, d), jnp.float32)
+    kq = quantize_rows(k_slab)  # codes [b,hkv,kw,d] + scales [b,hkv,kw]
+    vq = quantize_rows(v_slab)
+    k_deq = kq.q.astype(jnp.float32) * kq.s[..., None]
+    v_deq = vq.q.astype(jnp.float32) * vq.s[..., None]
+    scale = d**-0.5
+    want = _reference_chunk(q, k_deq, v_deq, scale, start)
+    got = flash_attention_chunk_kvq(
+        q, kq.q, kq.s, vq.q, vq.s, scale, jnp.int32(start),
+        block_q=bq, block_k=bk, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_chunk_continuation_untileable_window_falls_back_dense():
     """A cache window only 8-aligned (e.g. 88) cannot tile for bf16 — the
     model must fall back to the dense path instead of raising at trace

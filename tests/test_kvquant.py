@@ -146,3 +146,39 @@ async def test_ring_compaction_quantized():
         assert got_short == want_short
     finally:
         b.stop()
+
+
+@async_test
+async def test_chunked_flash_kvq_continuation_matches_oracle():
+    """Chunked prefill with use_flash_attention + int8 KV routes chunk
+    continuations through the quantized chunk kernel
+    (flash_attention_chunk_kvq, per-tile VMEM dequant) — greedy output must
+    still equal the Generator oracle running the same quantized math
+    through the dense path."""
+    cfg = _cfg(use_flash_attention=True)
+    params = init_params(cfg.with_(kv_quant="none"), jax.random.PRNGKey(5))
+    # > prefill_chunk so continuations run; group of 2 exercises the
+    # batched [m, C] chunk dispatch too
+    prompts = [
+        [(i * 7 + 3) % cfg.vocab_size for i in range(25)],
+        [(i * 5 + 1) % cfg.vocab_size for i in range(30)],
+    ]
+    gen = Generator(params, cfg.with_(use_flash_attention=False),
+                    max_seq_len=64, buckets=[8, 64])
+    want = [
+        [t for t, _ in gen.generate(p, SamplingParams(temperature=0.0, max_tokens=5))]
+        for p in prompts
+    ]
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64,
+                          buckets=[8, 64], prefill_chunk=8, max_group_long=2)
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=5)
+            return [t async for t in b.submit(p, sp)]
+
+        tasks = [asyncio.create_task(run(p)) for p in prompts]
+        await asyncio.sleep(0)
+        got = await asyncio.gather(*tasks)
+        assert list(got) == want
+    finally:
+        b.stop()

@@ -159,3 +159,34 @@ def test_ep_dispatch_is_all_to_all_with_bounded_bytes():
     c = lambda ep, k: _capacity(n // ep, _cfg(n_experts_used=k), 2.0)
     assert c(8, 2) * 8 * (8 // 8) <= c(4, 2) * 4 * (8 // 4)
     assert c(4, 4) == 2 * c(4, 2)
+
+
+def test_routed_drop_fraction_matches_serving_capacity_semantics():
+    """The drop diagnostic must mirror the serving path's capacity math:
+    single-shard uses the global capacity; ep > 1 uses the per-(shard,
+    expert) pair capacity over each local block — a skewed batch that fits
+    globally can overflow per-shard, and the diagnostic must see it."""
+    import jax
+    import jax.numpy as jnp
+
+    from nats_llm_studio_tpu.models.config import ModelConfig
+    from nats_llm_studio_tpu.models.llama import init_params
+    from nats_llm_studio_tpu.parallel.moe import _capacity, routed_drop_fraction
+
+    cfg = ModelConfig.tiny(
+        n_experts=4, n_experts_used=2, d_ff=32, n_layers=1,
+        n_heads=2, n_kv_heads=2, head_dim=8,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    blk = jax.tree.map(lambda a: a[0], params["blocks"])
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 8, cfg.d_model), jnp.float32)
+
+    d1 = routed_drop_fraction(x, blk, cfg, capacity_factor=2.0, ep=1)
+    d4 = routed_drop_fraction(x, blk, cfg, capacity_factor=2.0, ep=4)
+    assert 0.0 <= d1 <= 1.0 and 0.0 <= d4 <= 1.0
+    # a tiny capacity factor must force visible drops in both modes
+    tight1 = routed_drop_fraction(x, blk, cfg, capacity_factor=0.1, ep=1)
+    tight4 = routed_drop_fraction(x, blk, cfg, capacity_factor=0.1, ep=4)
+    assert tight1 > 0.0 and tight4 > 0.0
+    # generous capacity drops nothing
+    assert routed_drop_fraction(x, blk, cfg, capacity_factor=8.0, ep=1) == 0.0
