@@ -545,16 +545,20 @@ class ContinuousBatcher:
                 # idle-engine full-prefill programs: every bucket an admit
                 # length n in (C, max_seq) can map to — the pow2 ladder
                 # PLUS the clamped max_seq bucket (a non-pow2 max_seq like
-                # 4608 clamps there; sampling every C catches each edge)
-                full_buckets = sorted(
-                    {self._win_bucket(x) for x in range(C + 1, self.max_seq + 1, C)}
-                )
-                for b_ in full_buckets:
-                    logits, k1, v1 = self._prefill_full(
-                        self.params, jnp.zeros((1, b_), jnp.int32), k1, v1,
-                        jnp.int32(1),
+                # 4608 clamps there; sampling every C catches each edge).
+                # Flash-gated like the serving shortcut itself: without the
+                # kernel these programs are the dense-score blowup the
+                # chunked path exists to avoid, and serving never runs them
+                if self.cfg.use_flash_attention:
+                    full_buckets = sorted(
+                        {self._win_bucket(x) for x in range(C + 1, self.max_seq + 1, C)}
                     )
-                    n += 1
+                    for b_ in full_buckets:
+                        logits, k1, v1 = self._prefill_full(
+                            self.params, jnp.zeros((1, b_), jnp.int32), k1, v1,
+                            jnp.int32(1),
+                        )
+                        n += 1
             else:
                 km, vm = make_cache(self.cfg, m, self.max_seq)
                 for w in wins:

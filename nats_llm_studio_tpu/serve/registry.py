@@ -431,6 +431,13 @@ class LocalRegistry(Registry):
             mesh=self.mesh, max_queue=self.admit_queue_limit,
             max_queue_age_ms=self.admit_max_age_ms,
         )
+        if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
+            # opt-in: compile every chunk/full-prefill program at load time
+            # instead of pairing multi-second XLA compiles with the first
+            # unlucky long requests (adds ~minutes to an 8B load on TPU,
+            # which is why it is not the default)
+            n_warm = batcher.warm_chunk_programs()
+            log.info("warmed %d prefill programs for %s", n_warm, model_id)
         batcher.start()
         log.info("loaded %s in %.1fs (%s, %s)", model_id, time.perf_counter() - t0,
                  cfg.arch, self.dtype)

@@ -155,3 +155,23 @@ async def test_no_budget_known_means_no_check(tmp_path, monkeypatch):
     assert set(reg.loaded_engines()) == {"acme/a", "acme/b"}
     for eng in reg.loaded_engines().values():
         await eng.unload()
+
+
+@async_test
+async def test_warm_on_load_smoke(tmp_path, monkeypatch):
+    """TPU_WARM_ON_LOAD=1 pre-compiles the chunk/full-prefill programs at
+    load time (instead of on the first unlucky long request) and must not
+    break serving."""
+    models = tmp_path / "models"
+    _publish(models, "acme/a", 1)
+    monkeypatch.delenv("TPU_HBM_BUDGET_BYTES", raising=False)
+    monkeypatch.setenv("TPU_WARM_ON_LOAD", "1")
+    reg = LocalRegistry(ModelStore(models), dtype="float32", max_batch_slots=2,
+                        max_seq_len=64)
+    eng = await reg.get_engine("acme/a")
+    out = await eng.chat(
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3,
+         "temperature": 0.0}
+    )
+    assert out["usage"]["completion_tokens"] == 3
+    await eng.unload()
