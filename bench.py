@@ -670,7 +670,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
             d0 = len(batcher.stats.admit_delays())
             try:
                 async def client(i: int):
-                    completed = sheds = other = toks = 0
+                    completed = sheds = other = toks = abandoned = 0
                     ttfts_c = []
                     for r in range(rounds):
                         tag = base_tag + 16 * (rounds * i + r)
@@ -690,7 +690,10 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
                             else:
                                 other += 1
                                 break
-                    return completed, sheds, other, ttfts_c, toks
+                        else:  # shed on every attempt: the round is ABANDONED
+                            abandoned += 1  # keeps completed+other+abandoned
+                            # == rounds so the accounting always balances
+                    return completed, sheds, other, ttfts_c, toks, abandoned
 
                 t0 = time.perf_counter()
                 per = await asyncio.gather(*(client(i) for i in range(n_clients)))
@@ -704,9 +707,11 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
             other = sum(p[2] for p in per)
             ttfts = sorted(t * 1e3 for p in per for t in p[3])
             total_toks = sum(p[4] for p in per)
+            abandoned = sum(p[5] for p in per)
             return {
                 "clients": n_clients,
                 "rounds": rounds,
+                "abandoned_rounds": abandoned,
                 "slots": batcher.max_slots,
                 "admit_age_bound_ms": float(
                     os.environ.get("BENCH_SHED_AGE_MS", "2000")),
@@ -933,9 +938,7 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
         # coverage races on arrival timing (a missed pair lands a
         # multi-second compile inside the measured TTFT; seen as the
         # 5.2 s long-wave TTFT in the r5 iteration runs)
-        import asyncio as _aio
-
-        await _aio.to_thread(wave_batcher.warm_chunk_programs)
+        await asyncio.to_thread(wave_batcher.warm_chunk_programs)
         # solo short + short pair: the measured phase starts with 2
         # interference shorts decoding alone at a COLD ring — that is the
         # smallest decode window and the mpad-2 group admit, two programs
@@ -1027,13 +1030,11 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
         )
 
         async def xl_body(nc, one_chat):
-            import asyncio as _aio
-
             # every chunk window's program, compiled deterministically (the
             # pow2 ladder is 4-5 programs at 8-16k; an unwarmed one's
             # multi-second compile would land inside the measured TTFT),
             # then one chat to warm admit/finish/decode programs
-            await _aio.to_thread(xl_batcher.warm_chunk_programs, (1,))
+            await asyncio.to_thread(xl_batcher.warm_chunk_programs, (1,))
             await one_chat(0, make_long_prompt(1536), 8)
             # full-length pass: warms the measured request's own full-window
             # decode program too (post-TTFT, but keeps wall honest)

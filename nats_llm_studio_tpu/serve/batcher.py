@@ -899,6 +899,14 @@ class ContinuousBatcher:
                 jnp.int32(sp.top_k), jnp.float32(sp.top_p),
             )
             note_admit(n)
+            # reserve AFTER note_admit (whose cold-ring check must see the
+            # true all-empty table), BEFORE the prefill: a multi-second
+            # chunked/full prefill with every slot still None would read as
+            # idle() to the registry's eviction check and the engine could
+            # be unloaded mid-admit (admit_group_chunked already does this).
+            # The failure path releases via reset_after_failed_dispatch,
+            # which clears placeholders too.
+            self._slots[slot] = _RESERVED
             if n <= C:
                 # short prompt: the whole admit is one fused dispatch
                 bucket = self._bucket(n)
@@ -1296,6 +1304,12 @@ class ContinuousBatcher:
                                 else:
                                     waitlist.append(nxt)
                                     break
+                    # requests popped into the group are being ADMITTED, not
+                    # queued: refresh the mirror before the seconds-long
+                    # chunked admit so the depth bound doesn't count them
+                    # and spuriously shed new submits (measured against the
+                    # "queued-not-yet-admitted" semantics _enqueue documents)
+                    self._wl_len = len(waitlist)
                     if len(group) > 1:
                         try:
                             admit_group_chunked(group)
@@ -1312,6 +1326,7 @@ class ContinuousBatcher:
                         and self._bucket(len(waitlist[0].prompt_ids)) == head_bucket
                     ):
                         group.append(waitlist.pop(0))
+                self._wl_len = len(waitlist)  # popped-into-group != queued
                 if len(group) > 1:  # here only via the short same-bucket path
                     try:
                         handled = admit_group(group, head_bucket)

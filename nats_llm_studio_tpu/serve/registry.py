@@ -249,9 +249,12 @@ class LocalRegistry(Registry):
         self._load_lock = asyncio.Lock()
         self._requests = 0
         # HBM admission bookkeeping: estimated per-device bytes committed by
-        # each loaded engine, and last-use times for idle-eviction order
+        # each loaded engine, and last-use times for idle-eviction order.
+        # evict_grace_s: a recently-targeted engine is never evicted (see
+        # _pick_idle_victim)
         self._hbm_committed: dict[str, int] = {}
         self._last_used: dict[str, float] = {}
+        self.evict_grace_s = 1.0
 
     # -- Registry ------------------------------------------------------------
 
@@ -345,8 +348,16 @@ class LocalRegistry(Registry):
             return
         try:
             need = await asyncio.to_thread(self._estimate_load_bytes, paths)
-        except Exception:  # noqa: BLE001 — unparseable file fails in _load with a real error
-            return
+        except Exception:  # noqa: BLE001 — keep admitting with a floor, not blind
+            # an unexpected estimator failure must not silently disable
+            # admission (the engine would serve with ZERO committed bytes
+            # and the next load could OOM live serving). Fall back to the
+            # file sizes — a floor on the real footprint — and log loudly.
+            need = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
+            log.warning(
+                "HBM estimate failed for %s; admitting with file-size floor "
+                "%d MiB", model_id, need >> 20, exc_info=True,
+            )
         self._hbm_committed.pop(model_id, None)  # reloading: don't double count
         while sum(self._hbm_committed.values()) + need > budget:
             victim = self._pick_idle_victim()
@@ -382,9 +393,17 @@ class LocalRegistry(Registry):
         )["total"]
 
     def _pick_idle_victim(self) -> str | None:
+        # grace window: an engine targeted within the last second is never
+        # evicted even if its batcher looks idle — get_engine bumps
+        # _last_used BEFORE the caller submits, so this closes the
+        # check-then-act gap where a request is in flight toward a
+        # momentarily-idle batcher (and damps mutual-eviction loops when
+        # two models alternate under a one-model budget)
+        now = time.monotonic()
         idle = [
             mid for mid, eng in self._engines.items()
             if eng.batcher is not None and eng.batcher.idle
+            and now - self._last_used.get(mid, 0.0) > self.evict_grace_s
         ]
         if not idle:
             return None

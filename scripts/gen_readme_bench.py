@@ -160,9 +160,9 @@ def render(bench: dict, src_name: str) -> str:
         rows.append((
             "MoE on-chip (scaled Mixtral: 8 experts, top-2, int8)",
             f"routed decode **{_get(moe, 'routed.tok_s')} tok/s** at batch "
-            f"{_get(moe, 'geometry.batch')}; routed beats dense by "
-            f"**{moe.get('routed_prefill_speedup')}×** at prefill, "
-            f"{_get(moe, 'prefill_deep.routed_speedup')}× at deep prefill "
+            f"{_get(moe, 'geometry.batch')}; routed-vs-dense prefill "
+            f"speedup **{moe.get('routed_prefill_speedup')}×**, deep "
+            f"prefill {_get(moe, 'prefill_deep.routed_speedup')}× "
             "(`moe`) — decode is weight-traffic-bound at b32, so both forms "
             "read all experts and tie there",
         ))

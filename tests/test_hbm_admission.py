@@ -91,6 +91,7 @@ async def test_idle_engine_evicted_to_fit(tmp_path, monkeypatch):
     monkeypatch.setenv("TPU_HBM_BUDGET_BYTES", str(int(one * 1.5)))
     reg = LocalRegistry(ModelStore(models), dtype="float32", max_batch_slots=2,
                         max_seq_len=64)
+    reg.evict_grace_s = 0.0  # tests move faster than the production grace
     eng_a = await reg.get_engine("acme/a")
     out = await eng_a.chat(
         {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 2,
@@ -107,6 +108,28 @@ async def test_idle_engine_evicted_to_fit(tmp_path, monkeypatch):
     assert out["usage"]["completion_tokens"] == 2
     # A reloads on demand (evicting idle B in turn)
     eng_a2 = await reg.get_engine("acme/a")
+    assert set(reg.loaded_engines()) == {"acme/a"}
+    for eng in reg.loaded_engines().values():
+        await eng.unload()
+
+
+@async_test
+async def test_recently_used_idle_engine_not_evicted(tmp_path, monkeypatch):
+    """The eviction grace: an engine targeted within evict_grace_s is never
+    evicted even if its batcher is momentarily idle — closes the gap where
+    a client holds the engine (get_engine bumped _last_used) but has not
+    submitted yet."""
+    models = tmp_path / "models"
+    cfg = _publish(models, "acme/a", 1)
+    _publish(models, "acme/b", 2)
+    one = _estimate(cfg.with_(dtype="float32"))
+    monkeypatch.setenv("TPU_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+    reg = LocalRegistry(ModelStore(models), dtype="float32", max_batch_slots=2,
+                        max_seq_len=64)
+    reg.evict_grace_s = 60.0  # nothing in this test is ever past the grace
+    await reg.get_engine("acme/a")  # idle but freshly targeted
+    with pytest.raises(EngineError, match="insufficient device memory"):
+        await reg.get_engine("acme/b")
     assert set(reg.loaded_engines()) == {"acme/a"}
     for eng in reg.loaded_engines().values():
         await eng.unload()
