@@ -436,10 +436,14 @@ class ContinuousBatcher:
             sees one transfer in and one [B, n] token readback — and the
             next-token carry stays ON DEVICE (returned as ``tok``), so the
             NEXT burst can be dispatched before this one's tokens are read
-            back (the depth-2 pipeline in _run). ``window`` (static) bounds
-            attention reads to the live ring prefix while the ring has not
-            wrapped — the dominant HBM saving at partial cache occupancy
-            (~35% step time at half-full, granite-2b b32)."""
+            back (the depth-2 pipeline in _run). ``pos``/``steps`` are
+            device-resident carries too (returned advanced by n): with them
+            re-uploaded every burst, the per-burst host->device transfers
+            were a measurable slice of the served/device gap on a tunneled
+            chip. ``window`` (static) bounds attention reads to the live
+            ring prefix while the ring has not wrapped — the dominant HBM
+            saving at partial cache occupancy (~35% step time at half-full,
+            granite-2b b32)."""
 
             def body(carry, i):
                 tok, K, V = carry
@@ -454,7 +458,8 @@ class ContinuousBatcher:
             (tok, K, V), toks = jax.lax.scan(
                 body, (tok, K, V), jnp.arange(n, dtype=jnp.int32)
             )
-            return toks.T, K, V, tok  # [B, n], caches, device-side carry
+            # [B, n] tokens, caches, device-side carries
+            return toks.T, K, V, tok, pos + n, steps + n
 
         self._prefill1 = prefill1
         self._prefill_full = prefill_full
@@ -701,10 +706,17 @@ class ContinuousBatcher:
         # tunneled chip's ~50-100 ms round trip overlaps with compute
         # instead of serializing after every burst.
         tok_dev = jnp.zeros((B,), jnp.int32)
-        # per-slot sampling tensors, rebuilt only when membership changes
+        # per-slot sampling tensors AND position/step/seed carries, rebuilt
+        # only when membership changes (dirty); pos/steps advance ON DEVICE
+        # as decode carries, so steady-state bursts upload nothing but the
+        # ring scalar — three [B] transfers per burst were a measurable
+        # slice of the served/device gap on the tunneled chip
         temp = jnp.zeros((B,), jnp.float32)
         topk = jnp.zeros((B,), jnp.int32)
         topp = jnp.ones((B,), jnp.float32)
+        pos_dev = jnp.zeros((B,), jnp.int32)
+        steps_dev = jnp.zeros((B,), jnp.int32)
+        seeds_dev = jnp.zeros((B,), jnp.int32)
         dirty = False
 
         # host-side OPTIMISTIC per-slot counters, advanced at DISPATCH time
@@ -837,6 +849,7 @@ class ContinuousBatcher:
             the in-flight queue and pump() delivers it while the next burst
             computes."""
             nonlocal K, V, tok_dev, temp, topk, topp, dirty
+            nonlocal pos_dev, steps_dev, seeds_dev
             act = active()
             if not act:
                 return
@@ -847,6 +860,9 @@ class ContinuousBatcher:
                 )
                 topk = jnp.asarray([r.sp.top_k if r else 0 for r in live], jnp.int32)
                 topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in live], jnp.float32)
+                pos_dev = jnp.asarray(host_pos, jnp.int32)
+                steps_dev = jnp.asarray(host_steps, jnp.int32)
+                seeds_dev = jnp.asarray(host_seed, jnp.int32)
                 dirty = False
             # cap the burst so no active row can run past the cache capacity.
             # n is a static jit arg: snap to single steps near capacity
@@ -868,12 +884,9 @@ class ContinuousBatcher:
                 w = self._bucket(self._ring_next + n)
                 if w < self.max_seq:
                     window = w
-            pos = jnp.asarray(host_pos, jnp.int32)
-            seeds = jnp.asarray(host_seed, jnp.int32)
-            steps = jnp.asarray(host_steps, jnp.int32)
-            toks, K, V, tok_dev = self._decode(
-                self.params, tok_dev, K, V, pos, jnp.int32(self._ring_next),
-                seeds, steps, temp, topk, topp, n, window,
+            toks, K, V, tok_dev, pos_dev, steps_dev = self._decode(
+                self.params, tok_dev, K, V, pos_dev, jnp.int32(self._ring_next),
+                seeds_dev, steps_dev, temp, topk, topp, n, window,
             )
             if self._ring_next + n >= self.max_seq:
                 self._ring_wrapped = True

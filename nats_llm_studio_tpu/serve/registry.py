@@ -346,6 +346,7 @@ class LocalRegistry(Registry):
         budget = _hbm_budget_bytes()
         if budget is None:
             return
+        evictable = True
         try:
             need = await asyncio.to_thread(self._estimate_load_bytes, paths)
         except Exception:  # noqa: BLE001 — keep admitting with a floor, not blind
@@ -353,14 +354,25 @@ class LocalRegistry(Registry):
             # admission (the engine would serve with ZERO committed bytes
             # and the next load could OOM live serving). Fall back to the
             # file sizes — a floor on the real footprint — and log loudly.
+            # Such a load may well fail outright in _load, so it is never
+            # allowed to EVICT a healthy engine to make its room.
             need = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
+            evictable = False
             log.warning(
                 "HBM estimate failed for %s; admitting with file-size floor "
-                "%d MiB", model_id, need >> 20, exc_info=True,
+                "%d MiB (no eviction)", model_id, need >> 20, exc_info=True,
             )
         self._hbm_committed.pop(model_id, None)  # reloading: don't double count
         while sum(self._hbm_committed.values()) + need > budget:
-            victim = self._pick_idle_victim()
+            victim = self._pick_idle_victim() if evictable else None
+            if victim is None and evictable:
+                # an idle engine inside the eviction grace may become
+                # evictable within a second — wait a short remainder out
+                # rather than bounce the load with a hard error
+                wait = self._grace_remaining_s()
+                if wait is not None and wait <= 1.5:
+                    await asyncio.sleep(wait + 0.05)
+                    victim = self._pick_idle_victim()
             if victim is None:
                 committed = sum(self._hbm_committed.values())
                 raise EngineError(
@@ -408,6 +420,18 @@ class LocalRegistry(Registry):
         if not idle:
             return None
         return min(idle, key=lambda mid: self._last_used.get(mid, 0.0))
+
+    def _grace_remaining_s(self) -> float | None:
+        """Shortest time until some currently-idle engine exits the
+        eviction grace (None when no idle engine is inside it)."""
+        now = time.monotonic()
+        waits = [
+            self.evict_grace_s - (now - self._last_used.get(mid, 0.0))
+            for mid, eng in self._engines.items()
+            if eng.batcher is not None and eng.batcher.idle
+        ]
+        waits = [w for w in waits if w > 0]
+        return min(waits) if waits else None
 
     def _load(self, model_id: str, paths: list[str]) -> JaxChatEngine:
         t0 = time.perf_counter()
