@@ -422,24 +422,45 @@ def _make_bench_tokenizer(cfg):
     )
 
 
-def _phase_delta(batcher, s0: dict, n_delay0: int) -> dict:
+def _pctl(sorted_vals, q: float) -> float:
+    """Percentile over an ASCENDING-sorted list (0.0 for empty) — the one
+    index rule every CLIENT-SIDE reported p50/p95 shares. Batcher-side
+    percentiles come from obs.LogHistogram snapshots instead."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
+def _phase_hists(batcher) -> dict:
+    """Snapshot every batcher histogram at a phase boundary (for delta)."""
+    return {name: h.snapshot() for name, h in batcher.stats.histograms().items()}
+
+
+def _phase_delta(batcher, s0: dict, h0: dict) -> dict:
     """Batcher counters for ONE measured phase (difference against the
     snapshot taken before it) — the r3 artifact's tokens_per_step_avg mixed
     warmup and every phase into one cumulative number, hiding the
-    throughput phase's true occupancy."""
-    from nats_llm_studio_tpu.serve.batcher import _pctl
-
+    throughput phase's true occupancy. ``h0`` holds the phase-start
+    ``HistSnapshot`` per histogram (see ``_phase_hists``); subtracting
+    snapshots isolates each phase's distribution without any deque replay."""
     s1 = batcher.stats.snapshot()
-    delays = sorted(batcher.stats.admit_delays(n_delay0))
+    h1 = _phase_hists(batcher)
+    delays = h1["admit_queue_delay_ms"] - h0["admit_queue_delay_ms"]
     steps = s1["decode_steps"] - s0["decode_steps"]
     toks = s1["tokens"] - s0["tokens"]
-    return {
+    out = {
         "tokens": toks,
         "decode_steps": steps,
         "tokens_per_step_avg": round(toks / steps, 2) if steps else 0.0,
-        "admit_queue_delay_p50_ms": round(_pctl(delays, 0.5), 1),
-        "admit_queue_delay_p95_ms": round(_pctl(delays, 0.95), 1),
+        "admit_queue_delay_p50_ms": round(delays.percentile(0.5), 1),
+        "admit_queue_delay_p95_ms": round(delays.percentile(0.95), 1),
     }
+    for name in ("ttft_ms", "decode_step_ms"):
+        d = h1[name] - h0[name]
+        if d.count:
+            out[f"batcher_{name[:-3]}_p50_ms"] = round(d.percentile(0.5), 1)
+            out[f"batcher_{name[:-3]}_p95_ms"] = round(d.percentile(0.95), 1)
+    return out
 
 
 def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
@@ -466,7 +487,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
     """
     import asyncio
 
-    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher, _pctl
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
 
     tokenizer = _make_bench_tokenizer(cfg)
     slots = int(os.environ.get("BENCH_E2E_SLOTS", str(max(clients_a, clients_b))))
@@ -502,7 +523,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
             actually sees (the reference's clients are independent
             services, /root/reference/README.md:508-562)."""
             s0 = batcher.stats.snapshot()
-            d0 = len(batcher.stats.admit_delays())
+            d0 = _phase_hists(batcher)
 
             async def client(i: int):
                 out = []
@@ -599,7 +620,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
         # the roll timestamp quantify windowed-read recovery.
         async def ring_phase(base_tag: int) -> dict:
             s0 = batcher.stats.snapshot()
-            d0 = len(batcher.stats.admit_delays())
+            d0 = _phase_hists(batcher)
             gaps: list[tuple[float, float]] = []
             roll_t: float | None = None
 
@@ -667,7 +688,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
             batcher.max_queue = int(
                 os.environ.get("BENCH_SHED_QUEUE", str(4 * batcher.max_slots)))
             s0 = batcher.stats.snapshot()
-            d0 = len(batcher.stats.admit_delays())
+            d0 = _phase_hists(batcher)
             try:
                 async def client(i: int):
                     completed = sheds = other = toks = abandoned = 0
@@ -720,7 +741,7 @@ def e2e_nats_bench(cfg, params, model_id: str, clients_a: int = 8,
                 "completed": completed,
                 "sheds_observed_by_clients": sheds_seen,
                 "other_errors": other,
-                "batcher_shed_total": batcher.stats.shed,
+                "batcher_shed_total": batcher.stats.shed - s0["shed"],
                 "served_tok_s": round(total_toks / wall, 1),
                 "ttft_p50_ms": round(_pctl(ttfts, 0.5), 1),
                 "ttft_p95_ms": round(_pctl(ttfts, 0.95), 1),
@@ -905,7 +926,7 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
     tokenizer: 1 ASCII char = 1 token), not assumed."""
     import asyncio
 
-    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher, _pctl
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
 
     tokenizer = _make_bench_tokenizer(cfg)
     wave_seq = int(os.environ.get("BENCH_LONG_SEQ", "4608"))
@@ -968,7 +989,7 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
         # measured: 2 short interference streams decode while n_long long
         # prompts chunk-prefill through the same batcher
         s0 = wave_batcher.stats.snapshot()
-        d0 = len(wave_batcher.stats.admit_delays())
+        d0 = _phase_hists(wave_batcher)
         gaps: list[float] = []
         t0 = time.perf_counter()
         short_tasks = [

@@ -134,12 +134,14 @@ class Generator:
     # -- generation ----------------------------------------------------------
 
     def generate(
-        self, prompt_ids: list[int], sp: SamplingParams | None = None
+        self, prompt_ids: list[int], sp: SamplingParams | None = None, trace=None
     ) -> Iterator[tuple[int, GenStats]]:
         """Yield (token_id, running_stats) until a stop id or max_tokens.
 
         The final yielded stats carry total timing; ttft is measured at the
-        first yielded token.
+        first yielded token. ``trace`` is an optional ``obs.Trace`` stamped at
+        prefill / first-token / decode-done (first-write-wins, so a caller that
+        already marked a stage keeps its own timestamp).
         """
         sp = sp or SamplingParams()
         n = len(prompt_ids)
@@ -150,12 +152,17 @@ class Generator:
         bucket = self.bucket_for(n)
         stats = GenStats(prompt_tokens=n)
         t_start = time.perf_counter()
+        if trace is not None:
+            trace.mark("admit")
 
         tokens = jnp.asarray([prompt_ids + [0] * (bucket - n)], jnp.int32)
         k_cache, v_cache = make_cache(self.cfg, 1, self.max_seq)
         logits, k_cache, v_cache = self._prefill(
             self.params, tokens, k_cache, v_cache, jnp.zeros((1,), jnp.int32)
         )
+        if trace is not None:
+            jax.block_until_ready(logits)
+            trace.mark("prefill")
         key = jax.random.PRNGKey(sp.seed if sp.seed is not None else time.monotonic_ns() % 2**31)
         key, sub = jax.random.split(key)
         temp = jnp.full((1,), sp.temperature, jnp.float32)
@@ -169,6 +176,8 @@ class Generator:
             tok_id = int(next_tok[0])
             if i == 0:
                 stats.ttft_s = time.perf_counter() - t_start
+                if trace is not None:
+                    trace.mark("first_token")
             if tok_id in sp.stop_ids:
                 break
             stats.completion_tokens += 1
@@ -190,3 +199,5 @@ class Generator:
             )
             pos += 1
         stats.total_s = time.perf_counter() - t_start
+        if trace is not None:
+            trace.mark("decode_done")

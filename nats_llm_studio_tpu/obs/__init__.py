@@ -1,0 +1,23 @@
+"""Observability: trace context, bounded histograms, events, exposition.
+
+The subsystem PR 1 threads through every layer — see histogram.py,
+trace.py, events.py, prom.py. Import-light on purpose: nothing here may
+import jax or the transport (both import *us*).
+"""
+
+from .events import EVENTS, EventRing, emit
+from .histogram import HistSnapshot, LogHistogram
+from .prom import PromRenderer
+from .trace import STAGES, Trace, new_trace_id
+
+__all__ = [
+    "EVENTS",
+    "EventRing",
+    "emit",
+    "HistSnapshot",
+    "LogHistogram",
+    "PromRenderer",
+    "STAGES",
+    "Trace",
+    "new_trace_id",
+]

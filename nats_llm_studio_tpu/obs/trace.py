@@ -1,0 +1,78 @@
+"""Request-scoped trace context: one id, one monotonic timestamp per stage.
+
+A chat request's life is enqueue → admit dispatch → prefill → first token
+→ decode → publish; the trace rides the request object through the worker
+and the batcher owner thread, each layer stamping the stage it completes.
+The report is returned in the response ``stats`` block, so one
+``nats req lmstudio.chat_model`` shows the full latency waterfall with no
+extra round-trip (and no clock-sync problem: every mark comes from the
+same host's monotonic clock).
+
+Marks are first-write-wins: a stage is stamped where it first completes,
+and re-marking (e.g. a retry path crossing the same site) cannot move an
+already-recorded timestamp backwards.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# canonical stage order for the waterfall; unknown stages append after
+STAGES = ("recv", "enqueue", "admit", "prefill", "first_token", "decode_done", "publish")
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Trace:
+    __slots__ = ("trace_id", "_marks", "_lock")
+
+    def __init__(self, trace_id: str | None = None):
+        self.trace_id = trace_id or new_trace_id()
+        self._marks: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def mark(self, stage: str, t: float | None = None) -> None:
+        """Stamp ``stage`` at monotonic time ``t`` (now if omitted); the
+        first mark for a stage wins. Safe from any thread — the worker's
+        asyncio loop and the batcher owner thread stamp the same trace."""
+        if t is None:
+            t = time.monotonic()
+        with self._lock:
+            self._marks.setdefault(stage, t)
+
+    def marks(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._marks)
+
+    def report(self) -> dict:
+        """``{trace_id, spans_ms, marks_ms}``: per-stage durations between
+        consecutive *recorded* stages (absent stages are skipped, so a
+        fake engine without batcher marks still reports queue → publish),
+        plus each mark's offset from the first."""
+        marks = self.marks()
+        ordered = [(s, marks[s]) for s in STAGES if s in marks]
+        ordered += sorted(
+            ((s, t) for s, t in marks.items() if s not in STAGES), key=lambda x: x[1]
+        )
+        spans: dict[str, float] = {}
+        offsets: dict[str, float] = {}
+        if ordered:
+            t0 = ordered[0][1]
+            for stage, t in ordered:
+                offsets[stage] = round(max(0.0, t - t0) * 1e3, 3)
+            span_edges = {
+                "queue_ms": ("enqueue", "admit"),
+                "prefill_ms": ("admit", "prefill"),
+                "first_token_ms": ("prefill", "first_token"),
+                "decode_ms": ("first_token", "decode_done"),
+                "publish_ms": ("decode_done", "publish"),
+            }
+            for name, (a, b) in span_edges.items():
+                if a in marks and b in marks:
+                    spans[name] = round(max(0.0, marks[b] - marks[a]) * 1e3, 3)
+            spans["total_ms"] = round(max(0.0, ordered[-1][1] - t0) * 1e3, 3)
+        return {"trace_id": self.trace_id, "spans_ms": spans, "marks_ms": offsets}

@@ -35,6 +35,8 @@ from ..engine.generator import SamplingParams, default_buckets
 from ..models.config import ModelConfig
 from ..models.llama import forward, make_cache
 from ..engine.sampling import sample_rows
+from ..obs import LogHistogram, Trace
+from ..obs import emit as obs_emit
 from ..ops.kvcache import kv_copy_slice, kv_roll_s, kv_slice
 
 log = logging.getLogger(__name__)
@@ -59,14 +61,6 @@ class BatcherOverloaded(RuntimeError):
     measured a silent 38.6 s p95 admit delay without this."""
 
 
-def _pctl(sorted_vals, q: float) -> float:
-    """Percentile over an ASCENDING-sorted list (0.0 for empty) — the one
-    index rule every reported p50/p95 shares."""
-    if not sorted_vals:
-        return 0.0
-    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
-
-
 @dataclass
 class _Request:
     prompt_ids: list[int]
@@ -77,6 +71,8 @@ class _Request:
     pos: int = 0
     generated: int = 0
     t_enq: float = 0.0  # monotonic enqueue time (queue-delay metric)
+    t_admit: float = 0.0  # monotonic admit-dispatch time (prefill metric)
+    trace: Trace | None = None  # per-request span record (obs/trace.py)
     # set (from any thread; plain bool is GIL-safe) when the consumer is
     # gone — the owner thread frees the slot/queue entry at its next check
     # instead of decoding to max_tokens for nobody (VERDICT r4 missing #1)
@@ -97,39 +93,80 @@ class BatcherStats:
     ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
     cancelled: int = 0  # consumer-gone requests whose slot/queue entry was freed
     shed: int = 0  # requests rejected at the depth bound or dropped at the age bound
-    # per-request queue delay (enqueue -> admit DISPATCH), ms — the
-    # scheduling half of TTFT the worker controls (the other half is the
-    # prefill itself). Bounded so a long-lived worker cannot grow it
-    # without limit; bench phases slice copies for per-wave numbers.
-    # Appends happen on the batcher owner thread while health/metrics
-    # handlers snapshot from the asyncio thread — all reads go through
-    # admit_delays() under the lock (deque iteration raises RuntimeError
-    # if a concurrent append interleaves).
-    admit_delays_ms: collections.deque = field(
-        default_factory=lambda: collections.deque(maxlen=16384)
+    # bounded log-bucket histograms (obs/histogram.py): O(1) record on the
+    # batcher owner thread, O(buckets) snapshot from the asyncio metrics
+    # handlers, fixed memory for the life of the worker. Phase deltas come
+    # from snapshot subtraction (bench.py), not index bookkeeping.
+    admit_delay_ms: LogHistogram = field(default_factory=LogHistogram)
+    ttft_ms: LogHistogram = field(default_factory=LogHistogram)  # enqueue -> first token
+    prefill_ms: LogHistogram = field(default_factory=LogHistogram)  # admit -> first token
+    decode_step_ms: LogHistogram = field(default_factory=LogHistogram)  # per burst step
+    tokens_per_step: LogHistogram = field(
+        default_factory=lambda: LogHistogram(lo=1.0, hi=4096.0, growth=1.25)
     )
-    _delay_lock: threading.Lock = field(default_factory=threading.Lock)
+    shed_causes: dict = field(default_factory=dict)  # "depth" | "age" -> count
+    cancel_causes: dict = field(default_factory=dict)  # where the cancel landed
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def record_admit_delay(self, ms: float) -> None:
-        with self._delay_lock:
-            self.admit_delays_ms.append(ms)
+        """Queue delay (enqueue -> admit DISPATCH), ms — the scheduling
+        half of TTFT the worker controls (the other half is the prefill
+        itself, tracked separately in prefill_ms)."""
+        self.admit_delay_ms.record(ms)
 
-    def record_shed(self) -> None:
+    def record_shed(self, cause: str = "depth", waited_ms: float | None = None) -> None:
         """Sheds happen on TWO threads (depth bound: submitter's event
         loop; age bound: batcher owner) — a bare ``+= 1`` can lose counts
         between them, and the bench asserts exact shed totals."""
-        with self._delay_lock:
+        with self._lock:
             self.shed += 1
+            self.shed_causes[cause] = self.shed_causes.get(cause, 0) + 1
+        ev = {"cause": cause}
+        if waited_ms is not None:
+            ev["waited_ms"] = round(waited_ms, 1)
+        obs_emit("shed", **ev)
 
-    def admit_delays(self, start: int = 0) -> list[float]:
-        """Thread-safe copy (optionally from index ``start``). NOTE: once
-        the bounded deque has rotated, absolute indices shift — callers
-        slicing by a remembered length must read within one window."""
-        with self._delay_lock:
-            return list(self.admit_delays_ms)[start:]
+    def record_cancel(self, where: str = "active") -> None:
+        """Consumer-gone request reclaimed; all sites run on the owner
+        thread, but the event ring wants the *where* for diagnosis."""
+        self.cancelled += 1
+        self.cancel_causes[where] = self.cancel_causes.get(where, 0) + 1
+        obs_emit("cancel", where=where)
+
+    def shed_cause_counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.shed_causes)
+
+    def histograms(self) -> dict[str, LogHistogram]:
+        """Name -> histogram, for Prometheus exposition (serve/worker.py)."""
+        return {
+            "admit_queue_delay_ms": self.admit_delay_ms,
+            "ttft_ms": self.ttft_ms,
+            "prefill_ms": self.prefill_ms,
+            "decode_step_ms": self.decode_step_ms,
+            "tokens_per_step": self.tokens_per_step,
+        }
+
+    def counters(self) -> dict[str, int]:
+        """Monotonic counters, for Prometheus exposition."""
+        return {
+            "requests": self.requests,
+            "tokens": self.tokens,
+            "decode_steps": self.steps,
+            "grouped_admits": self.grouped_admits,
+            "chunked_group_admits": self.chunked_group_admits,
+            "ring_compactions": self.ring_compactions,
+            "cancelled": self.cancelled,
+            "shed": self.shed,
+        }
 
     def snapshot(self) -> dict:
-        d = sorted(self.admit_delays())
+        adm = self.admit_delay_ms.snapshot()
+        ttft = self.ttft_ms.snapshot()
+        pre = self.prefill_ms.snapshot()
+        dec = self.decode_step_ms.snapshot()
+        with self._lock:
+            shed_causes = dict(self.shed_causes)
         return {
             "requests": self.requests,
             "tokens": self.tokens,
@@ -140,10 +177,17 @@ class BatcherStats:
             "ring_compactions": self.ring_compactions,
             "cancelled": self.cancelled,
             "shed": self.shed,
+            "shed_causes": shed_causes,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
-            "admit_queue_delay_p50_ms": round(_pctl(d, 0.5), 1),
-            "admit_queue_delay_p95_ms": round(_pctl(d, 0.95), 1),
-            "admit_queue_delay_max_ms": round(d[-1], 1) if d else 0.0,
+            "admit_queue_delay_p50_ms": round(adm.percentile(0.5), 1),
+            "admit_queue_delay_p95_ms": round(adm.percentile(0.95), 1),
+            "admit_queue_delay_max_ms": round(adm.vmax or 0.0, 1),
+            "ttft_p50_ms": round(ttft.percentile(0.5), 1),
+            "ttft_p95_ms": round(ttft.percentile(0.95), 1),
+            "prefill_p50_ms": round(pre.percentile(0.5), 1),
+            "prefill_p95_ms": round(pre.percentile(0.95), 1),
+            "decode_step_p50_ms": round(dec.percentile(0.5), 1),
+            "decode_step_p95_ms": round(dec.percentile(0.95), 1),
         }
 
 
@@ -577,7 +621,9 @@ class ContinuousBatcher:
 
     # -- client API ----------------------------------------------------------
 
-    def _enqueue(self, prompt_ids: list[int], sp: SamplingParams) -> _Request:
+    def _enqueue(
+        self, prompt_ids: list[int], sp: SamplingParams, trace: Trace | None = None
+    ) -> _Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if len(prompt_ids) >= self.max_seq:
@@ -588,12 +634,15 @@ class ContinuousBatcher:
             loop=asyncio.get_running_loop(),
             out=asyncio.Queue(),
             t_enq=time.monotonic(),
+            trace=trace,
         )
+        if trace is not None:
+            trace.mark("enqueue", req.t_enq)
         with self._submit_lock:
             if self._stopping:
                 raise BatcherStopped("batcher is stopped; retry on another worker")
             if self.max_queue and self._inbox.qsize() + self._wl_len >= self.max_queue:
-                self.stats.record_shed()
+                self.stats.record_shed("depth")
                 raise BatcherOverloaded(
                     f"admit queue full ({self.max_queue} waiting); retry on "
                     f"another worker"
@@ -609,7 +658,11 @@ class ContinuousBatcher:
         self._cancels.put(req)
 
     async def submit(
-        self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
+        self,
+        prompt_ids: list[int],
+        sp: SamplingParams,
+        info: dict | None = None,
+        trace: Trace | None = None,
     ) -> AsyncIterator[int]:
         """Yield generated token ids for one request.
 
@@ -617,12 +670,16 @@ class ContinuousBatcher:
         "shutdown") is recorded in ``info["finish_reason"]`` so callers report
         cache-capacity terminations truthfully instead of re-deriving from
         token counts."""
-        async for batch in self.submit_batched(prompt_ids, sp, info=info):
+        async for batch in self.submit_batched(prompt_ids, sp, info=info, trace=trace):
             for tok in batch:
                 yield tok
 
     async def submit_batched(
-        self, prompt_ids: list[int], sp: SamplingParams, info: dict | None = None
+        self,
+        prompt_ids: list[int],
+        sp: SamplingParams,
+        info: dict | None = None,
+        trace: Trace | None = None,
     ) -> AsyncIterator[list[int]]:
         """Like ``submit`` but yields LISTS of tokens: everything already
         delivered when the consumer wakes comes out as one batch. A decode
@@ -634,7 +691,7 @@ class ContinuousBatcher:
             self.start()
         if not prompt_ids:
             return
-        req = self._enqueue(prompt_ids, sp)
+        req = self._enqueue(prompt_ids, sp, trace=trace)
         done = False
         try:
             while True:
@@ -756,14 +813,19 @@ class ContinuousBatcher:
             healthy stream (the K/V buffers are fine; only np.asarray
             readback errors mean poisoned device state)."""
             if rec[0] == "decode":
-                _, toks_ref, n, rows = rec
+                _, toks_ref, n, rows, t_disp = rec
                 ids = np.asarray(toks_ref)  # ONE [B, n] readback per burst
+                # observed per-step latency (dispatch -> tokens readable);
+                # includes pipeline wait, i.e. what a stream experiences
+                self.stats.decode_step_ms.record(
+                    (time.monotonic() - t_disp) * 1e3 / n
+                )
                 for slot, req in rows:
                     if self._slots[slot] is not req:
                         continue  # finished at an earlier record; zombie rows
                     if req.cancelled:
                         finish_slot(slot)
-                        self.stats.cancelled += 1
+                        self.stats.record_cancel("decode")
                         continue
                     try:
                         for j in range(n):
@@ -784,7 +846,7 @@ class ContinuousBatcher:
                         continue
                     if req.cancelled:
                         finish_slot(slot)
-                        self.stats.cancelled += 1
+                        self.stats.record_cancel("admit")
                         continue
                     try:
                         reason = self._deliver(req, int(ids[row]))
@@ -817,10 +879,10 @@ class ContinuousBatcher:
                     return
                 if 0 <= req.slot < B and self._slots[req.slot] is req:
                     finish_slot(req.slot)
-                    self.stats.cancelled += 1
+                    self.stats.record_cancel("active")
                 elif req in waitlist:
                     waitlist.remove(req)
-                    self.stats.cancelled += 1
+                    self.stats.record_cancel("waitlist")
 
         def maybe_compact() -> None:
             """Re-roll a wrapped ring when the live window is small enough
@@ -842,6 +904,7 @@ class ContinuousBatcher:
             self._ring_next = head
             self._ring_wrapped = False
             self.stats.ring_compactions += 1
+            obs_emit("ring_compaction", shift=shift, head=head, active=len(act))
 
         def decode_once() -> None:
             """Dispatch one decode burst (decode_burst steps) for every
@@ -892,16 +955,23 @@ class ContinuousBatcher:
                 self._ring_wrapped = True
             self._ring_next = (self._ring_next + n) % self.max_seq
             self.stats.steps += n
+            self.stats.tokens_per_step.record(float(len(act)))
             for i in act:
                 host_pos[i] += n
                 host_steps[i] += n
-            inflight.append(("decode", toks, n, [(i, self._slots[i]) for i in act]))
+            inflight.append(
+                ("decode", toks, n, [(i, self._slots[i]) for i in act], time.monotonic())
+            )
 
         def admit_one(req: _Request) -> None:
             nonlocal K, V, tok_dev, dirty
             # queue delay = enqueue -> admission START (the scheduling half
             # of TTFT); a chunked prefill's seconds are NOT queue delay
-            self.stats.record_admit_delay((time.monotonic() - req.t_enq) * 1e3)
+            t_admit = time.monotonic()
+            req.t_admit = t_admit
+            if req.trace is not None:
+                req.trace.mark("admit", t_admit)
+            self.stats.record_admit_delay((t_admit - req.t_enq) * 1e3)
             slot = self._slots.index(None)
             n = len(req.prompt_ids)
             C = self.prefill_chunk
@@ -982,6 +1052,8 @@ class ContinuousBatcher:
             host_pos[slot] = n
             host_steps[slot] = 1  # the admit program sampled at rng step 0
             host_seed[slot] = seed
+            if req.trace is not None:
+                req.trace.mark("prefill")  # prefill dispatched; first token next
             inflight.append(("admit", first, [(0, slot, req)]))
 
         def note_admit(n: int) -> None:
@@ -1052,8 +1124,12 @@ class ContinuousBatcher:
                 s = slots[j]
                 r.slot = s
                 r.pos = ns[j]
+                r.t_admit = t_admit
                 self.stats.requests += 1
                 self.stats.record_admit_delay((t_admit - r.t_enq) * 1e3)
+                if r.trace is not None:
+                    r.trace.mark("admit", t_admit)
+                    r.trace.mark("prefill")  # the group dispatch just went out
                 host_pos[s] = ns[j]
                 host_steps[s] = 1  # the admit program sampled at rng step 0
                 host_seed[s] = seeds[j]
@@ -1080,7 +1156,10 @@ class ContinuousBatcher:
             # the chunk loop's seconds are prefill, not queueing)
             t_start = time.monotonic()
             for r in reqs:
+                r.t_admit = t_start
                 self.stats.record_admit_delay((t_start - r.t_enq) * 1e3)
+                if r.trace is not None:
+                    r.trace.mark("admit", t_start)
             C = self.prefill_chunk
             ns = [len(r.prompt_ids) for r in reqs]
             note_admit(max(ns))
@@ -1147,6 +1226,8 @@ class ContinuousBatcher:
                 r.pos = ns[j]
                 self._slots[s] = r
                 self.stats.requests += 1
+                if r.trace is not None:
+                    r.trace.mark("prefill")  # chunk loop + finish dispatched
                 host_pos[s] = ns[j]
                 host_steps[s] = 1  # the finish program sampled at rng step 0
                 host_seed[s] = seeds[j]
@@ -1198,7 +1279,7 @@ class ContinuousBatcher:
                     self._drain_all("shutdown", waitlist)
                     return
                 if item.cancelled:
-                    self.stats.cancelled += 1
+                    self.stats.record_cancel("inbox")
                     continue
                 waitlist.append(item)
                 self._wl_len = len(waitlist)  # keep idle() honest mid-intake
@@ -1223,7 +1304,7 @@ class ContinuousBatcher:
                             self._drain_all("shutdown", waitlist)
                             return
                         if nxt.cancelled:
-                            self.stats.cancelled += 1
+                            self.stats.record_cancel("inbox")
                             continue
                         waitlist.append(nxt)
                         self._wl_len = len(waitlist)
@@ -1272,7 +1353,7 @@ class ContinuousBatcher:
                                 self._inbox.put(None)
                                 return False
                             if nxt.cancelled:
-                                self.stats.cancelled += 1
+                                self.stats.record_cancel("inbox")
                                 continue
                             if len(nxt.prompt_ids) > self.prefill_chunk:
                                 group.append(nxt)
@@ -1310,7 +1391,7 @@ class ContinuousBatcher:
                                     self._inbox.put(None)
                                     break
                                 if nxt.cancelled:
-                                    self.stats.cancelled += 1
+                                    self.stats.record_cancel("inbox")
                                     continue
                                 if len(nxt.prompt_ids) > self.prefill_chunk:
                                     group.append(nxt)
@@ -1368,7 +1449,7 @@ class ContinuousBatcher:
                 for r in waitlist:
                     waited_ms = (now - r.t_enq) * 1e3
                     if waited_ms > self.max_queue_age_ms:
-                        self.stats.record_shed()
+                        self.stats.record_shed("age", waited_ms=waited_ms)
                         try:
                             r.emit("err", BatcherOverloaded(
                                 f"shed after {waited_ms:.0f} ms queued "
@@ -1414,15 +1495,32 @@ class ContinuousBatcher:
         (the registry's idle-eviction check reads it immediately after a
         chat returns)."""
         if tok_id in req.sp.stop_ids:
+            if req.trace is not None:
+                req.trace.mark("decode_done")
             return "stop"
         req.generated += 1
         self.stats.tokens += 1
+        if req.generated == 1:
+            # the first delivered token closes both latency halves: TTFT
+            # (enqueue -> token) and prefill (admit dispatch -> token)
+            now = time.monotonic()
+            self.stats.ttft_ms.record((now - req.t_enq) * 1e3)
+            if req.t_admit:
+                self.stats.prefill_ms.record((now - req.t_admit) * 1e3)
+            if req.trace is not None:
+                req.trace.mark("first_token", now)
         req.emit("tok", tok_id)
         if req.generated >= req.sp.max_tokens or req.pos + 1 >= self.max_seq:
+            if req.trace is not None:
+                req.trace.mark("decode_done")
             return "length"
         return None
 
     def _drain_all(self, reason: str, waitlist: list[_Request] = ()) -> None:
+        # the owner thread is gone (or going): nothing is waiting any more,
+        # so zero the waitlist mirror unconditionally — a stopped batcher
+        # must read as idle (the registry's eviction check relies on it)
+        self._wl_len = 0
         for req in waitlist:
             req.emit("end", reason)
         for i, req in enumerate(self._slots):

@@ -21,6 +21,7 @@ from ..gguf.reader import open_gguf
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
+from ..obs import emit as obs_emit
 from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
 from ..utils.nuid import next_nuid
@@ -129,6 +130,11 @@ class JaxChatEngine(ChatEngine):
         return final if final is not None else self._completion("".join(parts), 0, 0, "stop")
 
     async def chat_stream(self, payload: dict) -> AsyncIterator[dict]:
+        # trace context injected by the worker (serve/worker.py): popped so
+        # the engine-facing payload stays the verbatim OpenAI body, handed
+        # to the batcher so its owner thread stamps the admit/prefill/
+        # first-token transitions on the same record
+        trace = payload.pop("_trace", None)
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
         stats = GenStats(prompt_tokens=len(prompt_ids))
@@ -141,7 +147,7 @@ class JaxChatEngine(ChatEngine):
             # message (the delta simply carries more text) — per-message
             # publish overhead is a real share of throughput at 64+ streams
             async for tok_batch in self.batcher.submit_batched(
-                prompt_ids, sp, info=end_info
+                prompt_ids, sp, info=end_info, trace=trace
             ):
                 if not toks:
                     stats.ttft_s = time.perf_counter() - t0
@@ -290,6 +296,7 @@ class LocalRegistry(Registry):
         self._last_used.pop(model_id, None)
         if eng is not None:
             await eng.unload()
+            obs_emit("engine_unload", model=model_id, reason="delete")
         try:
             return self.store.delete_local(model_id)
         except StoreError as e:
@@ -382,10 +389,12 @@ class LocalRegistry(Registry):
                     f"budget, and no loaded engine is idle to evict"
                 )
             log.info("evicting idle engine %s to fit %s", victim, model_id)
+            freed = self._hbm_committed.pop(victim, 0)
             eng = self._engines.pop(victim)
-            self._hbm_committed.pop(victim, None)
             self._last_used.pop(victim, None)
             await eng.unload()
+            obs_emit("engine_evict", model=victim, for_model=model_id,
+                     freed_bytes=freed)
         self._hbm_committed[model_id] = need
 
     def _estimate_load_bytes(self, paths: list[str]) -> int:
@@ -482,8 +491,10 @@ class LocalRegistry(Registry):
             n_warm = batcher.warm_chunk_programs()
             log.info("warmed %d prefill programs for %s", n_warm, model_id)
         batcher.start()
-        log.info("loaded %s in %.1fs (%s, %s)", model_id, time.perf_counter() - t0,
-                 cfg.arch, self.dtype)
+        load_s = time.perf_counter() - t0
+        log.info("loaded %s in %.1fs (%s, %s)", model_id, load_s, cfg.arch, self.dtype)
+        obs_emit("engine_load", model=model_id, seconds=round(load_s, 2),
+                 arch=cfg.arch, dtype=self.dtype)
         return JaxChatEngine(
             model_id, batcher, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
         )

@@ -25,16 +25,49 @@ single-reply clients (``nats req``) still receive a complete response.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
+import os
 import time
 
 from ..config import WorkerConfig
+from ..obs import EVENTS, PromRenderer, Trace, new_trace_id
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import envelope_error, envelope_ok
+from ..transport.protocol import TRACE_HEADER
 from .api import EngineError, ModelNotFound, Registry
 
 log = logging.getLogger(__name__)
+
+
+if hasattr(asyncio, "timeout"):
+    _timeout = asyncio.timeout  # Python >= 3.11
+else:
+
+    @contextlib.asynccontextmanager
+    async def _timeout(delay: float):
+        """asyncio.timeout backport for 3.10: arm a timer that cancels the
+        current task; the cancellation surfaces as TimeoutError at the
+        ``async with`` boundary, exactly like the 3.11 primitive."""
+        task = asyncio.current_task()
+        assert task is not None
+        fired = False
+
+        def _fire() -> None:
+            nonlocal fired
+            fired = True
+            task.cancel()
+
+        handle = asyncio.get_running_loop().call_later(delay, _fire)
+        try:
+            yield
+        except asyncio.CancelledError:
+            if fired:
+                raise asyncio.TimeoutError from None
+            raise
+        finally:
+            handle.cancel()
 
 
 class Worker:
@@ -50,6 +83,11 @@ class Worker:
         self._tokens_total = 0
         self._profiling = False
         self._t0 = time.monotonic()
+        # chat requests slower than this end-to-end land in the event ring
+        # for post-hoc diagnosis (0 disables)
+        self._slow_request_ms = float(
+            os.environ.get("OBS_SLOW_REQUEST_MS", "5000").strip() or 0
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -65,6 +103,8 @@ class Worker:
             cfg.subject("sync_model_from_bucket"): self.on_sync_model_from_bucket,
             cfg.subject("health"): self.on_health,
             cfg.subject("metrics"): self.on_metrics,
+            cfg.subject("metrics.prom"): self.on_metrics_prom,
+            cfg.subject("events"): self.on_events,
             cfg.subject("profile"): self.on_profile,
         }
         for subject, handler in subs.items():
@@ -110,8 +150,10 @@ class Worker:
     async def _respond_ok(self, msg: Msg, data=None) -> None:
         await self._respond_json(msg, envelope_ok(data))
 
-    async def _respond_error(self, msg: Msg, error: str, data=None, headers=None) -> None:
-        await self._respond_json(msg, envelope_error(error, data), headers=headers)
+    async def _respond_error(
+        self, msg: Msg, error: str, data=None, headers=None, trace_id=None
+    ) -> None:
+        await self._respond_json(msg, envelope_error(error, data, trace_id=trace_id), headers=headers)
 
     # -- handlers ------------------------------------------------------------
 
@@ -121,7 +163,7 @@ class Worker:
         at 200 since no HTTP hop exists any more)."""
         self._requests_total += 1
         try:
-            async with asyncio.timeout(self.config.list_timeout_s):
+            async with _timeout(self.config.list_timeout_s):
                 models = await self.registry.list_models()
         except asyncio.TimeoutError:
             await self._respond_error(msg, "timeout listing models")
@@ -147,7 +189,7 @@ class Worker:
             await self._respond_error(msg, "'identifier' is required")
             return
         try:
-            async with asyncio.timeout(self.config.pull_timeout_s):
+            async with _timeout(self.config.pull_timeout_s):
                 output = await self.registry.pull(identifier)
         except asyncio.TimeoutError:
             await self._respond_error(
@@ -178,7 +220,7 @@ class Worker:
             await self._respond_error(msg, "'model_id' is required")
             return
         try:
-            async with asyncio.timeout(self.config.delete_timeout_s):
+            async with _timeout(self.config.delete_timeout_s):
                 deleted_dir = await self.registry.delete(model_id)
         except asyncio.TimeoutError:
             await self._respond_error(msg, "error deleting model: deadline exceeded", {"model": model_id})
@@ -195,60 +237,109 @@ class Worker:
     async def on_chat_model(self, msg: Msg) -> None:
         """chat_model — nats_llm_studio.go:327-364. Payload is the OpenAI-style
         body passed through to the engine verbatim (:348); success wraps
-        {http_status, response} (:356-362)."""
+        {http_status, response} (:356-362).
+
+        Trace: the client's ``X-Trace-Id`` header (minted one if absent)
+        becomes a per-request span record. The batcher stamps its stage
+        transitions through ``payload["_trace"]``; the final envelope carries
+        ``trace_id`` and the response ``stats.trace`` holds the waterfall —
+        no extra round-trip."""
         self._requests_total += 1
+        trace = Trace((msg.headers or {}).get(TRACE_HEADER) or new_trace_id())
+        trace.mark("recv")
         if not msg.payload:
-            await self._respond_error(msg, "empty payload in ChatModel")
+            await self._respond_error(msg, "empty payload in ChatModel", trace_id=trace.trace_id)
             return
         try:
             payload = json.loads(msg.payload)
             if not isinstance(payload, dict):
                 raise ValueError("payload must be a JSON object")
         except ValueError as e:
-            await self._respond_error(msg, f"invalid JSON in ChatModel: {e}")
+            await self._respond_error(
+                msg, f"invalid JSON in ChatModel: {e}", trace_id=trace.trace_id
+            )
             return
         model_id = (payload.get("model") or "").strip()
         if not model_id:
-            await self._respond_error(msg, "'model' is required in ChatModel")
+            await self._respond_error(
+                msg, "'model' is required in ChatModel", trace_id=trace.trace_id
+            )
             return
         if payload.get("stream") and not msg.reply:
             return  # fire-and-forget stream request: nowhere to send tokens
         streaming = bool(payload.get("stream"))
+        payload["_trace"] = trace  # engines pop it; fakes ignore it
         try:
-            async with asyncio.timeout(self.config.chat_timeout_s):
+            async with _timeout(self.config.chat_timeout_s):
                 engine = await self.registry.get_engine(model_id)
                 if streaming:
-                    await self._chat_streaming(msg, engine, payload)
+                    await self._chat_streaming(msg, engine, payload, trace)
                 else:
                     response = await engine.chat(payload)
                     usage = response.get("usage") or {}
                     self._tokens_total += usage.get("completion_tokens", 0)
-                    await self._respond_ok(msg, {"http_status": 200, "response": response})
+                    trace.mark("publish")
+                    self._finish_trace(trace, model_id, response)
+                    await self._respond_json(
+                        msg,
+                        envelope_ok(
+                            {"http_status": 200, "response": response},
+                            trace_id=trace.trace_id,
+                        ),
+                    )
         except asyncio.TimeoutError:
             await self._error_terminal(
-                msg, "error in chat: deadline exceeded", {"model": model_id}, streaming
+                msg, "error in chat: deadline exceeded", {"model": model_id}, streaming, trace
             )
         except ModelNotFound as e:
-            await self._error_terminal(msg, f"model not found: {e}", {"model": model_id}, streaming)
+            await self._error_terminal(
+                msg, f"model not found: {e}", {"model": model_id}, streaming, trace
+            )
         except EngineError as e:
-            await self._error_terminal(msg, f"error in chat: {e}", {"model": model_id}, streaming)
+            await self._error_terminal(
+                msg, f"error in chat: {e}", {"model": model_id}, streaming, trace
+            )
         except Exception as e:  # noqa: BLE001 — mid-stream crash must still terminate the stream
             log.exception("chat handler failed for %s", model_id)
-            await self._error_terminal(msg, f"internal error: {e}", {"model": model_id}, streaming)
+            await self._error_terminal(
+                msg, f"internal error: {e}", {"model": model_id}, streaming, trace
+            )
 
-    async def _error_terminal(self, msg: Msg, error: str, data, streaming: bool) -> None:
+    def _finish_trace(self, trace: Trace, model_id: str, response) -> None:
+        """Inject the span waterfall into the response stats block and emit
+        a slow-request event when the end-to-end time crosses the threshold."""
+        report = trace.report()
+        if isinstance(response, dict):
+            response.setdefault("stats", {})["trace"] = report
+        total_ms = report["spans_ms"].get("total_ms", 0.0)
+        if self._slow_request_ms and total_ms > self._slow_request_ms:
+            EVENTS.emit(
+                "slow_request",
+                model=model_id,
+                trace_id=trace.trace_id,
+                total_ms=total_ms,
+                spans_ms=report["spans_ms"],
+            )
+
+    async def _error_terminal(
+        self, msg: Msg, error: str, data, streaming: bool, trace: Trace | None = None
+    ) -> None:
         """Error reply that, mid-stream, still carries the terminal
         ``Nats-Stream-Done`` header so ``request_stream`` consumers end
         cleanly instead of waiting out their idle timeout."""
         headers = {"Nats-Stream-Done": "1"} if streaming else None
-        await self._respond_error(msg, error, data, headers=headers)
+        await self._respond_error(
+            msg, error, data, headers=headers,
+            trace_id=trace.trace_id if trace is not None else None,
+        )
 
-    async def _chat_streaming(self, msg: Msg, engine, payload: dict) -> None:
+    async def _chat_streaming(self, msg: Msg, engine, payload: dict, trace: Trace) -> None:
         assert self.nc is not None
         if not msg.reply:
             return
         final: dict | None = None
         seq = 0
+        model_id = payload.get("model", "")
         async for chunk in engine.chat_stream(payload):
             if chunk.get("object") == "chat.completion":
                 final = chunk  # engines yield the aggregate last
@@ -270,9 +361,11 @@ class Worker:
             )
         usage = final.get("usage") or {}
         self._tokens_total += usage.get("completion_tokens", 0)
+        trace.mark("publish")
+        self._finish_trace(trace, model_id, final)
         await self.nc.publish(
             msg.reply,
-            envelope_ok({"http_status": 200, "response": final}),
+            envelope_ok({"http_status": 200, "response": final}, trace_id=trace.trace_id),
             headers={"Nats-Stream-Done": "1", "X-Seq": str(seq)},
         )
 
@@ -293,7 +386,7 @@ class Worker:
             await self._respond_error(msg, "'object_name' is required")
             return
         try:
-            async with asyncio.timeout(self.config.pull_timeout_s):
+            async with _timeout(self.config.pull_timeout_s):
                 local_path = await self.registry.sync_from_bucket(name, req.get("model_id"))
         except asyncio.TimeoutError:
             await self._respond_error(msg, "error syncing model: deadline exceeded", {"object": name})
@@ -341,6 +434,74 @@ class Worker:
             "devices": devices,
         }
         await self._respond_ok(msg, data)
+
+    def render_prometheus(self) -> str:
+        """Worker totals + registry gauges + per-engine batcher counters and
+        histograms in Prometheus text exposition (obs/prom.py)."""
+        r = PromRenderer()
+        r.gauge("lmstudio_uptime_seconds", round(time.monotonic() - self._t0, 3))
+        r.counter("lmstudio_requests_total", self._requests_total,
+                  help="NATS requests handled by this worker")
+        r.counter("lmstudio_tokens_total", self._tokens_total,
+                  help="completion tokens generated")
+        reg = self.registry.stats()
+        for key in ("models_cached", "models_loaded", "engine_requests",
+                    "hbm_committed_bytes"):
+            v = reg.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                r.gauge(f"lmstudio_registry_{key}", v)
+        r.gauge("lmstudio_events_emitted_total", EVENTS.emitted)
+        for mid, eng in self.registry.loaded_engines().items():
+            stats = getattr(getattr(eng, "batcher", None), "stats", None)
+            if stats is None or not hasattr(stats, "histograms"):
+                continue
+            labels = {"model": mid}
+            for name, v in stats.counters().items():
+                r.counter(f"lmstudio_batcher_{name}_total", v, labels=labels)
+            r.gauge("lmstudio_batcher_peak_active_slots", stats.peak_active, labels=labels)
+            for cause, v in stats.shed_cause_counts().items():
+                r.counter("lmstudio_batcher_shed_by_cause_total", v,
+                          labels={**labels, "cause": cause})
+            for name, h in stats.histograms().items():
+                r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
+        return r.render()
+
+    async def on_metrics_prom(self, msg: Msg) -> None:
+        """metrics.prom — the same observability surface as ``metrics`` but
+        rendered as Prometheus text exposition: point any scraper at
+        ``nats req lmstudio.metrics.prom ''`` (or a thin HTTP bridge) and
+        the admit-delay/TTFT/prefill/decode-step histograms arrive with
+        cumulative ``le`` buckets, per-model labels, and counter families.
+        Replies raw text, not a JSON envelope — scrapers want the body."""
+        await self._respond_json(msg, self.render_prometheus().encode())
+
+    async def on_events(self, msg: Msg) -> None:
+        """events — the structured event ring (obs/events.py): sheds,
+        cancels, ring compactions, engine load/evict, slow requests.
+        Payload (optional): ``{kind?, limit?}`` filters by event kind and
+        caps the reply to the most recent N (default 100)."""
+        try:
+            req = json.loads(msg.payload) if msg.payload and msg.payload.strip() else {}
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in Events: {e}")
+            return
+        kind = req.get("kind")
+        try:
+            limit = int(req.get("limit", 100))
+        except (TypeError, ValueError):
+            await self._respond_error(msg, "'limit' must be an integer")
+            return
+        await self._respond_ok(
+            msg,
+            {
+                "events": EVENTS.snapshot(kind=kind, limit=limit),
+                "emitted_total": EVENTS.emitted,
+                "dropped": EVENTS.dropped,
+                "capacity": EVENTS.capacity,
+            },
+        )
 
     async def on_profile(self, msg: Msg) -> None:
         """profile — capture a jax.profiler device trace for ``seconds``

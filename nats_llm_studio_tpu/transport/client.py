@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import AsyncIterator, Awaitable, Callable
 from urllib.parse import urlparse
 
+from ..obs import new_trace_id
 from ..utils import next_nuid
 from . import protocol as p
 
@@ -267,8 +268,14 @@ class NatsClient:
         headers: dict[str, str] | None = None,
     ) -> Msg:
         """Single request, single reply — the pattern every reference subject
-        uses (/root/reference/README.md:86-88, :131-134, :181-186, :237-245)."""
+        uses (/root/reference/README.md:86-88, :131-134, :181-186, :237-245).
+
+        A trace id is minted into the ``X-Trace-Id`` header when the caller
+        did not set one, so every request is traceable end-to-end (the worker
+        echoes it in the envelope and stamps per-stage spans under it)."""
         await self._ensure_resp_sub()
+        headers = dict(headers) if headers else {}
+        headers.setdefault(p.TRACE_HEADER, new_trace_id())
         token = next_nuid()
         inbox = f"{self._inbox_prefix}.{token}"
         fut: asyncio.Future[Msg] = asyncio.get_running_loop().create_future()
@@ -289,13 +296,16 @@ class NatsClient:
         payload: bytes = b"",
         timeout: float = 120.0,
         idle_timeout: float = 30.0,
+        headers: dict[str, str] | None = None,
     ) -> AsyncIterator[Msg]:
         """Multi-reply request: yields every message published to the reply
         inbox until one carries the ``Nats-Stream-Done`` header (the terminal
-        aggregate) or timeout elapses."""
+        aggregate) or timeout elapses. Mints ``X-Trace-Id`` like request()."""
+        headers = dict(headers) if headers else {}
+        headers.setdefault(p.TRACE_HEADER, new_trace_id())
         inbox = self.new_inbox()
         sub = await self.subscribe(inbox)
-        await self.publish(subject, payload, reply=inbox)
+        await self.publish(subject, payload, reply=inbox, headers=headers)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         try:

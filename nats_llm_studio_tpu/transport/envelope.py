@@ -14,17 +14,23 @@ from typing import Any
 FALLBACK = b'{"ok":false,"error":"internal serialization error"}'
 
 
-def envelope_ok(data: Any = None) -> bytes:
+def envelope_ok(data: Any = None, trace_id: str | None = None) -> bytes:
     env: dict[str, Any] = {"ok": True}
     if data is not None:
         env["data"] = data
+    if trace_id:
+        # top-level, next to ok/error: omitted entirely for untraced ops so
+        # the reference's byte-for-byte envelope shape is unchanged there
+        env["trace_id"] = trace_id
     return _dump(env)
 
 
-def envelope_error(error: str, data: Any = None) -> bytes:
+def envelope_error(error: str, data: Any = None, trace_id: str | None = None) -> bytes:
     env: dict[str, Any] = {"ok": False, "error": error}
     if data is not None:
         env["data"] = data
+    if trace_id:
+        env["trace_id"] = trace_id
     return _dump(env)
 
 

@@ -190,6 +190,11 @@ class ProtocolError(Exception):
 
 HDR_PREAMBLE = b"NATS/1.0\r\n"
 
+# request-scoped trace id (obs/trace.py): minted by the client when absent,
+# read by the worker, echoed in the response envelope — one id names the
+# request across every hop without touching the JSON payload
+TRACE_HEADER = "X-Trace-Id"
+
 
 def parse_headers(raw: bytes) -> dict[str, str]:
     headers: dict[str, str] = {}

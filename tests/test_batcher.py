@@ -385,7 +385,7 @@ async def test_wide_group_admit_deterministic(model):
         got = await asyncio.gather(*tasks)
         assert list(got) == want
         assert b.stats.grouped_admits >= 9, b.stats.snapshot()  # wide path ran
-        assert len(b.stats.admit_delays_ms) == len(prompts)
+        assert b.stats.admit_delay_ms.count == len(prompts)
         snap = b.stats.snapshot()
         assert snap["admit_queue_delay_p95_ms"] >= snap["admit_queue_delay_p50_ms"] >= 0.0
     finally:

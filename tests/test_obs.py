@@ -1,0 +1,301 @@
+"""obs/ (PR 1): log-bucket histograms, trace context, event ring, Prometheus
+rendering — unit coverage plus the end-to-end trace/metrics path over a real
+embedded broker + tiny real model."""
+
+import json
+import random
+import threading
+
+from nats_llm_studio_tpu.obs import (
+    EventRing,
+    LogHistogram,
+    PromRenderer,
+    Trace,
+)
+
+from conftest import async_test
+
+
+# -- LogHistogram ------------------------------------------------------------
+
+
+def test_bucket_boundaries_geometric():
+    h = LogHistogram(lo=1.0, hi=1000.0, growth=2.0)
+    assert h.bounds[0] == 1.0
+    assert h.bounds[-1] == 1000.0
+    assert all(b2 > b1 for b1, b2 in zip(h.bounds, h.bounds[1:]))
+    # every edge grows by at most the growth factor (last may clamp to hi)
+    for b1, b2 in zip(h.bounds, h.bounds[1:]):
+        assert b2 / b1 <= 2.0 + 1e-9
+    # a second histogram on the same ladder shares the tuple (cache)
+    assert LogHistogram(lo=1.0, hi=1000.0, growth=2.0).bounds is h.bounds
+
+
+def test_record_underflow_overflow_and_extrema():
+    h = LogHistogram(lo=1.0, hi=100.0, growth=2.0)
+    h.record(0.001)  # below lo -> first bucket
+    h.record(5000.0)  # above hi -> overflow bucket
+    h.record(7.0)
+    snap = h.snapshot()
+    assert snap.count == h.count == 3
+    assert snap.counts[0] == 1
+    assert snap.counts[-1] == 1
+    assert sum(snap.counts) == snap.count
+    assert snap.vmin == 0.001 and snap.vmax == 5000.0 == h.max
+    assert abs(snap.total - 5007.001) < 1e-9
+    # percentile never escapes the recorded extrema
+    assert 0.001 <= snap.percentile(0.0) <= 5000.0
+    assert snap.percentile(0.999) == 5000.0
+
+
+def test_percentile_tracks_exact_on_known_distributions():
+    """Histogram percentiles vs exact sorted-index percentiles: within the
+    bucket relative width (growth 1.25 -> 25%) on uniform, exponential-ish,
+    and constant distributions."""
+    rng = random.Random(7)
+    dists = {
+        "uniform": [rng.uniform(1.0, 1000.0) for _ in range(5000)],
+        "heavy_tail": [2.0 ** rng.uniform(0, 12) for _ in range(5000)],
+        "constant": [42.0] * 1000,
+    }
+    for name, values in dists.items():
+        h = LogHistogram()  # default ladder: lo=0.01, hi=1e7, growth=1.25
+        for v in values:
+            h.record(v)
+        exact_sorted = sorted(values)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            exact = exact_sorted[min(len(values) - 1, int(len(values) * q))]
+            est = h.percentile(q)
+            assert abs(est - exact) <= 0.25 * exact + 1e-6, (
+                f"{name} q={q}: est={est} exact={exact}"
+            )
+
+
+def test_snapshot_subtraction_isolates_a_phase():
+    h = LogHistogram(lo=0.1, hi=1e4, growth=1.25)
+    for _ in range(200):
+        h.record(5.0)
+    s0 = h.snapshot()
+    for _ in range(300):
+        h.record(500.0)
+    delta = h.snapshot() - s0
+    assert delta.count == 300
+    assert abs(delta.total - 300 * 500.0) < 1e-6
+    # the delta's distribution is ONLY the second phase
+    assert abs(delta.percentile(0.5) - 500.0) <= 0.25 * 500.0
+    # mismatched ladders refuse to subtract
+    import pytest
+
+    with pytest.raises(ValueError):
+        h.snapshot() - LogHistogram(lo=1.0, hi=10.0, growth=2.0).snapshot()
+
+
+def test_concurrent_record_and_snapshot():
+    h = LogHistogram()
+    n_threads, per_thread = 4, 5000
+    bad = []
+    stop = threading.Event()
+
+    def writer(seed):
+        rng = random.Random(seed)
+        for _ in range(per_thread):
+            h.record(rng.uniform(0.1, 1e4))
+
+    def reader():
+        while not stop.is_set():
+            s = h.snapshot()
+            if sum(s.counts) != s.count:
+                bad.append(s)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    assert not bad, "snapshot saw counts/count out of sync (torn read)"
+    final = h.snapshot()
+    assert final.count == n_threads * per_thread
+    assert sum(final.counts) == final.count
+
+
+# -- Trace -------------------------------------------------------------------
+
+
+def test_trace_marks_first_write_wins_and_report_spans():
+    tr = Trace("abcd1234abcd1234")
+    tr.mark("recv", 10.0)
+    tr.mark("enqueue", 10.1)
+    tr.mark("admit", 10.3)
+    tr.mark("prefill", 10.7)
+    tr.mark("first_token", 10.8)
+    tr.mark("decode_done", 11.5)
+    tr.mark("publish", 11.6)
+    tr.mark("admit", 99.0)  # re-mark must NOT move the recorded time
+    rep = tr.report()
+    assert rep["trace_id"] == "abcd1234abcd1234"
+    spans = rep["spans_ms"]
+    assert abs(spans["queue_ms"] - 200.0) < 1e-6
+    assert abs(spans["prefill_ms"] - 400.0) < 1e-6
+    assert abs(spans["first_token_ms"] - 100.0) < 1e-6
+    assert abs(spans["decode_ms"] - 700.0) < 1e-6
+    assert abs(spans["publish_ms"] - 100.0) < 1e-6
+    assert abs(spans["total_ms"] - 1600.0) < 1e-6
+    assert rep["marks_ms"]["recv"] == 0.0
+    assert abs(rep["marks_ms"]["publish"] - 1600.0) < 1e-6
+
+
+def test_trace_report_skips_absent_stages():
+    tr = Trace()
+    tr.mark("recv", 1.0)
+    tr.mark("publish", 1.5)
+    rep = tr.report()
+    assert abs(rep["spans_ms"]["total_ms"] - 500.0) < 1e-6
+    assert "queue_ms" not in rep["spans_ms"]  # no enqueue/admit marks
+    assert len(tr.trace_id) == 16
+
+
+# -- EventRing ---------------------------------------------------------------
+
+
+def test_event_ring_capacity_filter_and_dropped():
+    ring = EventRing(capacity=4)
+    for i in range(6):
+        ring.emit("shed" if i % 2 else "cancel", i=i)
+    assert ring.emitted == 6 and ring.dropped == 2
+    evs = ring.snapshot()
+    assert [e["seq"] for e in evs] == [2, 3, 4, 5]  # oldest-first window
+    sheds = ring.snapshot(kind="shed")
+    assert all(e["kind"] == "shed" for e in sheds) and len(sheds) == 2
+    assert [e["seq"] for e in ring.snapshot(limit=2)] == [4, 5]
+    ring.clear()
+    assert ring.emitted == 0 and ring.snapshot() == []
+
+
+# -- PromRenderer ------------------------------------------------------------
+
+
+def test_prom_renderer_families_and_histogram_exposition():
+    h = LogHistogram(lo=1.0, hi=8.0, growth=2.0)  # bounds 1,2,4,8
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.record(v)
+    r = PromRenderer()
+    r.counter("app_requests_total", 5, labels={"model": "a"}, help="reqs")
+    r.counter("app_requests_total", 7, labels={"model": "b"})
+    r.gauge("app_up", 1)
+    r.histogram("app_latency_ms", h.snapshot(), labels={"model": "a"})
+    text = r.render()
+    # ONE TYPE line per family even with two label sets
+    assert text.count("# TYPE app_requests_total counter") == 1
+    assert '\napp_requests_total{model="a"} 5\n' in text
+    assert '\napp_requests_total{model="b"} 7\n' in text
+    # cumulative le buckets, +Inf equals total count, sum/count present
+    assert '\napp_latency_ms_bucket{le="1",model="a"} 1\n' in text
+    assert '\napp_latency_ms_bucket{le="2",model="a"} 2\n' in text
+    assert '\napp_latency_ms_bucket{le="4",model="a"} 3\n' in text
+    assert '\napp_latency_ms_bucket{le="+Inf",model="a"} 4\n' in text
+    assert '\napp_latency_ms_count{model="a"} 4\n' in text
+    import pytest
+
+    with pytest.raises(ValueError):
+        r.gauge("app_requests_total", 1)  # type conflict on one family
+
+
+# -- end-to-end: trace + metrics.prom + events over the wire -----------------
+
+
+@async_test
+async def test_trace_and_metrics_e2e_over_embedded_broker(tmp_path):
+    """One real chat request carries a client-chosen X-Trace-Id through the
+    broker, worker, engine, and batcher owner thread; the response stats show
+    the full per-stage waterfall; metrics.prom exposes the histograms; the
+    events subject serves the engine_load event."""
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.store import ModelStore
+
+    from test_serve_e2e import E2E, build_tiny_gguf
+
+    async with E2E() as h:
+        src = tmp_path / "tiny.gguf"
+        build_tiny_gguf(src)
+        pub = ModelStore(tmp_path / "pub", objstore=h.objstore)
+        pub.import_file(src, "acme/obs")
+        await pub.publish_model("acme/obs")
+
+        store = ModelStore(tmp_path / "worker", objstore=h.objstore)
+        worker = Worker(
+            WorkerConfig(nats_url=h.broker.url), LocalRegistry(store, dtype="float32")
+        )
+        await worker.start()
+        resp = await h.req("pull_model", {"identifier": "acme/obs"})
+        assert resp["ok"], resp
+
+        trace_id = "cafe0123deadbeef"
+        msg = await h.nc.request(
+            "lmstudio.chat_model",
+            json.dumps(
+                {
+                    "model": "acme/obs",
+                    "messages": [{"role": "user", "content": "hi there"}],
+                    "max_tokens": 6,
+                    "temperature": 0.0,
+                }
+            ).encode(),
+            timeout=50.0,
+            headers={"X-Trace-Id": trace_id},
+        )
+        env = json.loads(msg.payload)
+        assert env["ok"], env
+        # the client's id is echoed top-level AND inside the stats report
+        assert env["trace_id"] == trace_id
+        rep = env["data"]["response"]["stats"]["trace"]
+        assert rep["trace_id"] == trace_id
+        spans = rep["spans_ms"]
+        for k in ("queue_ms", "prefill_ms", "first_token_ms", "decode_ms",
+                  "publish_ms", "total_ms"):
+            assert k in spans and spans[k] >= 0.0, spans
+        for stage in ("recv", "enqueue", "admit", "prefill", "first_token",
+                      "decode_done", "publish"):
+            assert stage in rep["marks_ms"], rep
+
+        # an omitted header still yields a server-minted trace
+        msg = await h.nc.request(
+            "lmstudio.chat_model",
+            json.dumps(
+                {
+                    "model": "acme/obs",
+                    "messages": [{"role": "user", "content": "again"}],
+                    "max_tokens": 3,
+                    "temperature": 0.0,
+                }
+            ).encode(),
+            timeout=50.0,
+        )
+        env2 = json.loads(msg.payload)
+        assert env2["ok"] and env2["trace_id"] and env2["trace_id"] != trace_id
+
+        # Prometheus exposition covers the tentpole histograms + counters
+        msg = await h.nc.request("lmstudio.metrics.prom", b"", timeout=10.0)
+        text = msg.payload.decode()
+        assert "# TYPE lmstudio_admit_queue_delay_ms histogram" in text
+        assert "# TYPE lmstudio_ttft_ms histogram" in text
+        assert "# TYPE lmstudio_decode_step_ms histogram" in text
+        assert 'lmstudio_ttft_ms_bucket{le="+Inf",model="acme/obs"}' in text
+        assert 'lmstudio_admit_queue_delay_ms_count{model="acme/obs"} 2' in text
+        assert "# TYPE lmstudio_requests_total counter" in text
+        assert "lmstudio_batcher_requests_total" in text
+
+        # the event ring saw the engine load; the subject serves it
+        resp = await h.req("events", {"kind": "engine_load"})
+        assert resp["ok"], resp
+        assert any(
+            ev["model"] == "acme/obs" for ev in resp["data"]["events"]
+        ), resp["data"]
+        assert resp["data"]["capacity"] > 0
+
+        await worker.drain()
