@@ -17,6 +17,10 @@ batch sweep up to 96, best batch reported. Also measured, in `detail`:
   read back from usage.
 * `long_prefill` — single-dispatch 16k-token flash prefill (SURVEY §5
   long-context), tok/s and seconds.
+* `prefix_cache` — shared-system-prompt serving with the automatic prefix
+  KV cache ON vs OFF (serve/prefix_cache.py): TTFT p50 and total prefill
+  seconds for the same sequential turn mix, plus the scraped
+  `lmstudio_prefix_cache_hit_tokens_total` Prometheus counter.
 * `moe` — scaled Mixtral geometry (8 experts, top-2) on-chip: decode tok/s
   and prefill for BOTH dispatch forms (routed vs dense).
 * `granite2b` — config-1 parity (the round-1/2 flagship), decode tok/s.
@@ -32,6 +36,7 @@ from __future__ import annotations
 import gc
 import json
 import os
+import sys
 import time
 from functools import partial
 
@@ -839,6 +844,12 @@ def _drive_engine(cfg, params, model_id, tokenizer, batcher, body_fn):
         def stats(self):
             return {"models_loaded": [model_id]}
 
+        def loaded_engines(self):
+            # base Registry returns {} — expose the engine so the worker's
+            # Prometheus exposition renders its per-model rows (the prefix
+            # phase asserts hit counters off the wire, not in-process)
+            return {model_id: engine}
+
     async def drive():
         broker = await EmbeddedBroker().start()
         worker = Worker(WorkerConfig(nats_url=broker.url), Preloaded())
@@ -1088,6 +1099,122 @@ def e2e_long_context_bench(cfg, params, model_id: str, n_long: int = 4,
 
 
 # ---------------------------------------------------------------------------
+# prefix cache: shared-system-prompt serving, cache ON vs OFF
+# ---------------------------------------------------------------------------
+
+
+def prefix_cache_bench(cfg, params, model_id: str) -> dict:
+    """Shared-system-prompt serving with the prefix KV cache ON vs OFF
+    (serve/prefix_cache.py): the same sequential turn mix — a fixed
+    multi-chunk "system prompt + history" resent with a fresh tail each
+    turn, the reference product's steady state — served twice on
+    identically-sized engines. ON must beat OFF on BOTH TTFT p50 and total
+    prefill seconds (only the uncached suffix is prefilled on a hit). The
+    worker's Prometheus exposition is scraped so the hit counter is proven
+    on the wire, not just in-process."""
+    import asyncio
+
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+    tokenizer = _make_bench_tokenizer(cfg)
+    seq = int(os.environ.get("BENCH_PREFIX_SEQ", "4608"))
+    chunk = int(os.environ.get("BENCH_PREFIX_CHUNK", "512"))
+    slots = int(os.environ.get("BENCH_PREFIX_SLOTS", "4"))
+    n_turns = int(os.environ.get("BENCH_PREFIX_TURNS", "6"))
+    blocks = int(os.environ.get("BENCH_PREFIX_BLOCKS", "64"))
+    # the shared prefix ends 17 tokens past a chunk edge, so every reuse is
+    # a PARTIAL hit resuming mid-prompt (the common case: a resent history
+    # rarely ends exactly on a block boundary)
+    shared = make_long_prompt(min(5 * chunk, seq // 2) + 17)
+
+    def run_mode(cache_blocks: int) -> dict:
+        batcher = ContinuousBatcher(
+            params, cfg, max_slots=slots, max_seq_len=seq,
+            buckets=[b for b in (512, 1024, 2048) if b < seq] + [seq],
+            prefill_chunk=chunk, prefix_cache_blocks=cache_blocks,
+        )
+
+        async def body(nc, one_chat):
+            await asyncio.to_thread(batcher.warm_chunk_programs, (1,))
+            warm = make_long_prompt(min(chunk + 300, seq - 64))
+            await one_chat(900, warm, 8)
+            if cache_blocks > 0:
+                # resend: the repeat takes the HIT path, compiling the
+                # cached-block write + suffix programs outside the window
+                await one_chat(901, warm, 8)
+            s0 = batcher.stats.snapshot()
+            h0 = _phase_hists(batcher)
+            t0 = time.perf_counter()
+            turns = [
+                await one_chat(1000 + i, f"{shared} [turn {i:03d}] reply now", 16)
+                for i in range(n_turns)
+            ]
+            wall = time.perf_counter() - t0
+            h1 = _phase_hists(batcher)
+            phase = _phase_delta(batcher, s0, h0)
+            prefill_s = (h1["prefill_ms"] - h0["prefill_ms"]).total / 1e3
+            hit_total = 0.0
+            prom_line = ""
+            if cache_blocks > 0:
+                try:
+                    reply = await nc.request("lmstudio.metrics.prom", b"",
+                                             timeout=30.0)
+                    for ln in reply.payload.decode().splitlines():
+                        if ln.startswith("lmstudio_prefix_cache_hit_tokens_total"):
+                            prom_line = ln
+                            hit_total = float(ln.rsplit(" ", 1)[-1])
+                            break
+                except Exception:  # noqa: BLE001 — exposition is best-effort
+                    pass
+            ttfts = sorted(r["ttft_s"] * 1e3 for r in turns
+                           if r["ttft_s"] == r["ttft_s"])
+            out = {
+                "turns": n_turns,
+                "prompt_tokens_each": turns[0]["prompt_tokens"],
+                "ttft_p50_ms": round(_pctl(ttfts, 0.5), 1),
+                "ttft_max_ms": round(ttfts[-1], 1) if ttfts else 0.0,
+                "prefill_s": round(prefill_s, 3),
+                "wall_s": round(wall, 2),
+                "parse_failures": sum(1 for r in turns if r["parse_fail"]),
+                "batcher_phase": phase,
+            }
+            pc = batcher.prefix_cache
+            if pc is not None:
+                out["cache"] = pc.stats()
+                out["prom_hit_tokens_total"] = hit_total
+                out["prom_line"] = prom_line
+            return out
+
+        out = _drive_engine(cfg, params, model_id, tokenizer, batcher, body)
+        gc.collect()
+        return out
+
+    on = run_mode(blocks)
+    off = run_mode(0)
+    return {
+        "max_seq_len": seq,
+        "prefill_chunk": chunk,
+        "shared_prefix_tokens": len(shared),
+        "cache_on": on,
+        "cache_off": off,
+        "ttft_p50_speedup": (
+            round(off["ttft_p50_ms"] / on["ttft_p50_ms"], 2)
+            if on["ttft_p50_ms"] else 0.0
+        ),
+        "prefill_s_saved": round(off["prefill_s"] - on["prefill_s"], 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def _print_final(obj: dict) -> None:
+    """Emit the results object as ONE compact JSON line, guaranteed LAST on
+    stdout: flush both streams first so buffered warmup chatter cannot land
+    after (or interleave with) the line a harness machine-parses."""
+    sys.stderr.flush()
+    sys.stdout.flush()
+    print(json.dumps(obj, separators=(",", ":")), flush=True)
 
 
 def main() -> None:
@@ -1102,13 +1229,13 @@ def main() -> None:
 
         params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
         r = decode_bench(cfg, params, batch=2, prompt_len=16, seq_len=64, steps=8)
-        print(json.dumps({
+        _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
             "vs_baseline": 0.0,
             "detail": {"quant": cfg.dtype, "platform": detail["platform"],
                        "tiny": r},
-        }))
+        })
         return
 
     # -- headline: Llama-3-8B int8, batch sweep -----------------------------
@@ -1181,12 +1308,39 @@ def main() -> None:
 
     # -- long-context SERVING: >=4k-token prompts via chat_model -------------
     if os.environ.get("BENCH_E2E_LONG", "1") != "0":
+        # one retry on transient transport failures (the r5 artifact lost
+        # this whole phase to a single "response body closed" mid-stream);
+        # deterministic errors still fail fast on the first attempt
+        for attempt in (0, 1):
+            try:
+                detail["e2e_long"] = e2e_long_context_bench(
+                    cfg, params, "bench/llama3-8b"
+                )
+                detail.pop("e2e_long_error", None)
+                if attempt:
+                    detail["e2e_long"]["retried"] = True
+                break
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                msg = f"{type(e).__name__}: {e}"
+                detail["e2e_long_error"] = msg
+                transient = any(s in str(e).lower() for s in (
+                    "response body closed", "timeout", "timed out",
+                    "connection", "broken pipe", "reset by peer",
+                ))
+                if attempt or not transient:
+                    break
+                detail["e2e_long_first_error"] = msg
+                gc.collect()
+        gc.collect()
+
+    # -- prefix cache: shared-system-prompt serving, ON vs OFF ---------------
+    if os.environ.get("BENCH_PREFIX", "1") != "0":
         try:
-            detail["e2e_long"] = e2e_long_context_bench(
+            detail["prefix_cache"] = prefix_cache_bench(
                 cfg, params, "bench/llama3-8b"
             )
         except Exception as e:  # noqa: BLE001 — report, don't die
-            detail["e2e_long_error"] = f"{type(e).__name__}: {e}"
+            detail["prefix_cache_error"] = f"{type(e).__name__}: {e}"
         gc.collect()
 
     del params
@@ -1220,13 +1374,13 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, don't die
             detail["moe_error"] = f"{type(e).__name__}: {e}"
 
-    print(json.dumps({
+    _print_final({
         "metric": f"llama3_8b_int8_decode_tok_s.{best_b}",
         "value": tok_s,
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s / NORTH_STAR_TOK_S, 3),
         "detail": detail,
-    }))
+    })
 
 
 if __name__ == "__main__":

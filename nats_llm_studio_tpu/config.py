@@ -67,10 +67,19 @@ class WorkerConfig:
     admit_max_age_ms: float = field(
         default_factory=lambda: float(_env("ADMIT_MAX_AGE_MS", "30000"))
     )
+    # automatic prefix KV cache (serve/prefix_cache.py): per-engine budget
+    # in prefill-chunk blocks, priced against the HBM admission budget.
+    # PREFIX_CACHE=0 is the hard off-switch (wins over PREFIX_CACHE_BLOCKS);
+    # PREFIX_CACHE_BLOCKS=0 also disables.
+    prefix_cache_blocks: int = field(
+        default_factory=lambda: int(_env("PREFIX_CACHE_BLOCKS", "64"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count
             self.admit_queue_limit = 4 * self.max_batch_slots
+        if _env("PREFIX_CACHE", "").strip().lower() in ("0", "false", "off"):
+            self.prefix_cache_blocks = 0
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

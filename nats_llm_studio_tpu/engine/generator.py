@@ -173,7 +173,30 @@ class Generator:
         pos = n
         max_new = min(sp.max_tokens, self.max_seq - n)
         for i in range(max_new):
-            tok_id = int(next_tok[0])
+            # one-step lookahead: dispatch decode step i+1 BEFORE blocking
+            # on step i's host readback (int() below), so the device
+            # computes the next token while the previous one crosses the
+            # wire — the readback round trip no longer serializes between
+            # steps. Token i+1 is always sampled from the (i+1)-th key
+            # split, exactly as the sequential loop did, so outputs are
+            # unchanged; one speculative step is wasted on early stop
+            # (next_tok is not donated, so the dispatch is harmless).
+            cur = next_tok
+            if i < max_new - 1:
+                key, sub = jax.random.split(key)
+                next_tok, k_cache, v_cache = self._decode(
+                    self.params,
+                    cur[:, None],
+                    k_cache,
+                    v_cache,
+                    jnp.full((1,), pos, jnp.int32),
+                    sub,
+                    temp,
+                    tk,
+                    tp,
+                )
+                pos += 1
+            tok_id = int(cur[0])
             if i == 0:
                 stats.ttft_s = time.perf_counter() - t_start
                 if trace is not None:
@@ -183,21 +206,6 @@ class Generator:
             stats.completion_tokens += 1
             stats.total_s = time.perf_counter() - t_start
             yield tok_id, stats
-            if i == max_new - 1:
-                break
-            key, sub = jax.random.split(key)
-            next_tok, k_cache, v_cache = self._decode(
-                self.params,
-                next_tok[:, None],
-                k_cache,
-                v_cache,
-                jnp.full((1,), pos, jnp.int32),
-                sub,
-                temp,
-                tk,
-                tp,
-            )
-            pos += 1
         stats.total_s = time.perf_counter() - t_start
         if trace is not None:
             trace.mark("decode_done")

@@ -109,6 +109,30 @@ def kv_slice(cache, idx, sizes):
     )
 
 
+def kv_nbytes(cache) -> int:
+    """Device bytes a cache (bf16 array or KVQ pytree) occupies — the
+    prefix cache's HBM accounting unit. 0 for None."""
+    if cache is None:
+        return 0
+    if is_quantized(cache):
+        return cache.q.size * cache.q.dtype.itemsize + cache.s.size * cache.s.dtype.itemsize
+    return cache.size * cache.dtype.itemsize
+
+
+def kv_gather_block(cache, row: int, start: int, length: int):
+    """Copy one row's S-axis block [start, start+length) out of a
+    [B, L, H, S, D]-layout cache as a fresh [1, L, H, length, D] array (or
+    KVQ pair). Static Python slicing — eager, no compiled program — so the
+    prefix cache can harvest blocks from a transient row cache before the
+    donating finish-admit call consumes it."""
+    if not is_quantized(cache):
+        return jnp.copy(cache[row : row + 1, :, :, start : start + length, :])
+    return KVQ(
+        q=jnp.copy(cache.q[row : row + 1, :, :, start : start + length, :]),
+        s=jnp.copy(cache.s[row : row + 1, :, :, start : start + length]),
+    )
+
+
 def kv_roll_s(cache, shift, s_axis: int):
     """jnp.roll along the sequence axis (ring alignment / compaction)."""
     if not is_quantized(cache):

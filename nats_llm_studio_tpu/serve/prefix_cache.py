@@ -1,0 +1,324 @@
+"""Automatic prefix KV cache: radix-tree prompt reuse across requests.
+
+The `chat_model` contract renders every request through the GGUF chat
+template, so real traffic shares long common prefixes — the system prompt
+plus the resent conversation history is re-prefilled on every turn, and the
+r5 bench put admit+prefill p95 in the seconds under load. SGLang's
+RadixAttention and vLLM's PagedAttention showed block-granular KV reuse
+across requests is the single largest serving win for templated chat
+workloads; this module is that capability for the continuous batcher.
+
+Design:
+
+* A radix tree keyed on **token-id chunks** of exactly ``prefill_chunk``
+  tokens — the chunk the batcher's chunked-prefill program already uses, so
+  every cached block boundary is a boundary the prefill pipeline can resume
+  from (``prefill1`` with ``uniform_start`` continues from any chunk edge).
+  Fixed-size edges make the "radix tree" a trie over chunk tuples: one dict
+  hop per chunk, no partial-edge splitting ever needed.
+* Each node owns one **already-materialized KV block pair** — the
+  ``[1, L, Hkv, C, D]`` slice of a prefilled transient row cache, bf16 array
+  or ``ops.kvcache.KVQ`` pytree depending on ``ModelConfig.kv_quant``. A
+  quantized serving cache stores quantized blocks: a hit re-inserts the
+  exact codes+scales a full prefill would have written, so greedy outputs
+  are bit-identical with the cache on or off.
+* Nodes may also hold the **chunk-end logits row** (``[1, 1, vocab]``): a
+  prompt whose every token is covered by cached chunks samples its first
+  token straight from the stored logits and skips prefill entirely. Nodes
+  harvested from the single-dispatch flash path lack intermediate logits;
+  a full-length match against such a node degrades to a partial hit (the
+  final chunk re-prefills) rather than guessing.
+* **Refcounted eviction.** ``match`` pins every node on the returned hit;
+  the batcher releases the pin after the copy dispatches are enqueued.
+  Eviction (capacity pressure, ``resize``, the registry's HBM-pressure
+  drop) detaches pinned nodes from the tree but must never free their
+  arrays — a detached-while-pinned node is marked dead and freed at
+  ``release`` time instead. LRU order is a monotonic use tick; only leaves
+  are evictable, so an interior block shared by live descendants outlives
+  them.
+
+Thread-safety: the batcher owner thread does match/insert/release; the
+registry's event loop may clear/resize under HBM pressure and metrics
+handlers read the stats — everything mutating takes the one lock. Device
+arrays themselves are immutable; the lock only guards the tree.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..obs import LogHistogram
+from ..obs import emit as obs_emit
+from ..ops.kvcache import kv_nbytes
+
+
+def serving_chunk(max_seq: int, prefill_chunk: int = 256) -> int:
+    """The chunk size a batcher with these settings actually serves with
+    (mirrors ``ContinuousBatcher.__init__``: halved until it divides the
+    ring) — the registry's HBM estimate must price the same block shape
+    the batcher will cache."""
+    chunk = max(8, prefill_chunk)
+    while max_seq % chunk and chunk > 8:
+        chunk //= 2
+    return chunk
+
+
+def prefix_block_bytes(cfg, chunk: int, kv_quant: str | None = None) -> int:
+    """Worst-case device bytes of ONE cached entry: the K+V block pair for
+    ``chunk`` positions plus the optional chunk-end logits row. Used by the
+    registry's HBM admission to commit the cache's budget up front."""
+    quant = (kv_quant if kv_quant is not None else cfg.kv_quant) == "int8"
+    dtype_bytes = 4 if cfg.dtype == "float32" else 2
+    per_pos = (
+        cfg.head_dim * (1 if quant else dtype_bytes) + (4 if quant else 0)
+    )
+    kv = 2 * cfg.n_layers * cfg.n_kv_heads * chunk * per_pos
+    return kv + 4 * cfg.vocab_size  # + [1, 1, vocab] f32 end-logits
+
+
+class _Node:
+    """One chunk edge: the KV block for tokens [depth*C, (depth+1)*C)."""
+
+    __slots__ = ("key", "parent", "children", "kb", "vb", "logits", "refs",
+                 "tick", "dead", "nbytes")
+
+    def __init__(self, key, parent, kb, vb, logits):
+        self.key = key
+        self.parent = parent
+        self.children: dict[tuple, _Node] = {}
+        self.kb = kb
+        self.vb = vb
+        self.logits = logits
+        self.refs = 0
+        self.tick = 0
+        self.dead = False
+        self.nbytes = kv_nbytes(kb) + kv_nbytes(vb)
+
+    def free(self) -> None:
+        self.kb = self.vb = self.logits = None
+
+
+@dataclass
+class PrefixHit:
+    """A pinned longest-prefix match. ``blocks`` are alive until
+    ``PrefixCache.release`` — even if eviction detaches the nodes first."""
+
+    tokens: int  # chunk-aligned covered length, > 0
+    nodes: list = field(default_factory=list)
+
+    @property
+    def blocks(self) -> list[tuple[Any, Any]]:
+        return [(nd.kb, nd.vb) for nd in self.nodes]
+
+    @property
+    def end_logits(self):
+        """Chunk-end logits of the deepest matched node (None unless the
+        harvesting prefill computed them)."""
+        return self.nodes[-1].logits if self.nodes else None
+
+
+class PrefixCache:
+    """Radix (chunk-trie) cache of prefilled KV blocks with LRU eviction."""
+
+    def __init__(self, chunk: int, capacity_blocks: int):
+        if chunk <= 0:
+            raise ValueError("chunk must be positive")
+        self.chunk = chunk
+        self.capacity = max(0, capacity_blocks)
+        self._root: dict[tuple, _Node] = {}
+        self._lock = threading.Lock()
+        self._tick = 0
+        self._blocks = 0
+        self._bytes = 0
+        # counters for Prometheus exposition (serve/worker.py) and the
+        # bench's shared-prefix phase; hit_tokens is the acceptance metric
+        self.hits = 0
+        self.misses = 0
+        self.full_hits = 0
+        self.hit_tokens = 0
+        self.inserted_blocks = 0
+        self.evicted_blocks = 0
+        self.hit_tokens_hist = LogHistogram(lo=1.0, hi=131072.0, growth=1.5)
+
+    # -- lookup ---------------------------------------------------------------
+
+    def _chunks(self, token_ids) -> list[tuple]:
+        C = self.chunk
+        return [
+            tuple(token_ids[i : i + C])
+            for i in range(0, len(token_ids) - C + 1, C)
+        ]
+
+    def peek(self, token_ids) -> int:
+        """Matched-token count without pinning (group-admit routing: a
+        request with a usable hit is admitted alone so the hit path runs)."""
+        with self._lock:
+            nodes = self._walk(token_ids)
+            return len(nodes) * self.chunk
+
+    def _walk(self, token_ids) -> list[_Node]:
+        """Longest cached full-chunk prefix (lock held). A match covering
+        the WHOLE prompt needs the last node's logits to produce the first
+        token; without them the final chunk is dropped so the batcher
+        re-prefills it (and backfills the logits on insert)."""
+        nodes: list[_Node] = []
+        level = self._root
+        for key in self._chunks(token_ids):
+            nd = level.get(key)
+            if nd is None:
+                break
+            nodes.append(nd)
+            level = nd.children
+        if nodes and len(nodes) * self.chunk == len(token_ids) and nodes[-1].logits is None:
+            nodes.pop()
+        return nodes
+
+    def match(self, token_ids) -> PrefixHit | None:
+        """Longest cached prefix, PINNED. Caller must ``release`` the hit
+        once the blocks' copy dispatches are enqueued (or on any failure)."""
+        with self._lock:
+            nodes = self._walk(token_ids)
+            if not nodes:
+                self.misses += 1
+                return None
+            self._tick += 1
+            for nd in nodes:
+                nd.refs += 1
+                nd.tick = self._tick
+            covered = len(nodes) * self.chunk
+            self.hits += 1
+            self.hit_tokens += covered
+            if covered == len(token_ids):
+                self.full_hits += 1
+            self.hit_tokens_hist.record(float(covered))
+            return PrefixHit(tokens=covered, nodes=nodes)
+
+    def release(self, hit: PrefixHit) -> None:
+        """Unpin a hit; frees blocks that were evicted while pinned."""
+        with self._lock:
+            for nd in hit.nodes:
+                nd.refs -= 1
+                if nd.dead and nd.refs <= 0:
+                    nd.free()
+        hit.nodes = []
+
+    # -- insertion / eviction -------------------------------------------------
+
+    def insert(self, token_ids, blocks, logits_list=None) -> int:
+        """Insert the prompt's full-chunk blocks along one tree path.
+
+        ``blocks[j]`` is the (k, v) block pair for chunk j, or None when the
+        caller skipped materializing it (the chunk was just matched, so its
+        node already exists). ``logits_list[j]`` is the chunk-end logits row
+        or None; existing nodes missing logits are backfilled, which is how
+        a flash-harvested path later earns full-hit capability. Returns the
+        number of NEW blocks inserted."""
+        if self.capacity <= 0:
+            return 0
+        chunks = self._chunks(token_ids)
+        added = 0
+        with self._lock:
+            self._tick += 1
+            level = self._root
+            parent = None
+            for j, key in enumerate(chunks):
+                nd = level.get(key)
+                if nd is None:
+                    if j >= len(blocks) or blocks[j] is None:
+                        break  # nothing to create this node from
+                    kb, vb = blocks[j]
+                    lg = logits_list[j] if logits_list else None
+                    nd = _Node(key, parent, kb, vb, lg)
+                    level[key] = nd
+                    self._blocks += 1
+                    self._bytes += nd.nbytes
+                    self.inserted_blocks += 1
+                    added += 1
+                elif nd.logits is None and logits_list and j < len(logits_list):
+                    nd.logits = logits_list[j]
+                nd.tick = self._tick
+                parent = nd
+                level = nd.children
+            evicted = self._evict_to_locked(self.capacity)
+        if evicted:
+            obs_emit("prefix_evict", blocks=evicted, resident=self.blocks)
+        return added
+
+    def _evict_to_locked(self, capacity: int) -> int:
+        """Detach LRU leaves until at most ``capacity`` blocks remain
+        (lock held). A pinned leaf is detached but NOT freed — an admit in
+        flight still reads its arrays; ``release`` frees it. Interior
+        nodes become leaves as their children go, so repeated passes drain
+        arbitrarily deep chains."""
+        evicted = 0
+        while self._blocks > capacity:
+            leaf = None
+            stack = list(self._root.values())
+            while stack:
+                nd = stack.pop()
+                if nd.children:
+                    stack.extend(nd.children.values())
+                elif leaf is None or nd.tick < leaf.tick:
+                    leaf = nd
+            if leaf is None:
+                break
+            owner = leaf.parent.children if leaf.parent is not None else self._root
+            owner.pop(leaf.key, None)
+            self._blocks -= 1
+            self._bytes -= leaf.nbytes
+            self.evicted_blocks += 1
+            evicted += 1
+            leaf.dead = True
+            if leaf.refs <= 0:
+                leaf.free()
+        return evicted
+
+    def resize(self, capacity_blocks: int) -> int:
+        """Shrink (or grow) the block budget; evicts immediately. The
+        registry's HBM-pressure hook calls ``resize(0)`` to drop the cache
+        without touching blocks an in-flight admit has pinned."""
+        with self._lock:
+            self.capacity = max(0, capacity_blocks)
+            evicted = self._evict_to_locked(self.capacity)
+        if evicted:
+            obs_emit("prefix_evict", blocks=evicted, resident=self.blocks,
+                     resized_to=self.capacity)
+        return evicted
+
+    def clear(self) -> int:
+        with self._lock:
+            return self._evict_to_locked(0)
+
+    # -- introspection --------------------------------------------------------
+
+    @property
+    def blocks(self) -> int:
+        return self._blocks
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def counters(self) -> dict[str, int]:
+        """Monotonic counters for Prometheus exposition
+        (``lmstudio_prefix_cache_<name>_total``)."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "full_hits": self.full_hits,
+            "hit_tokens": self.hit_tokens,
+            "inserted_blocks": self.inserted_blocks,
+            "evicted_blocks": self.evicted_blocks,
+        }
+
+    def stats(self) -> dict[str, Any]:
+        snap = self.hit_tokens_hist.snapshot()
+        return {
+            **self.counters(),
+            "blocks": self._blocks,
+            "capacity_blocks": self.capacity,
+            "bytes": self._bytes,
+            "hit_tokens_p50": round(snap.percentile(0.5), 1),
+        }

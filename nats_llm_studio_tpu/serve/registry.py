@@ -48,6 +48,21 @@ def _hbm_budget_bytes() -> int | None:
     return None
 
 
+def _prefix_cache_blocks_env(default: int = 64) -> int:
+    """Per-engine prefix-cache budget in blocks (serve/prefix_cache.py).
+    ``PREFIX_CACHE=0`` (or false/off) is the hard off-switch; otherwise
+    ``PREFIX_CACHE_BLOCKS`` sizes the radix cache (0 also disables)."""
+    if os.environ.get("PREFIX_CACHE", "").strip().lower() in ("0", "false", "off"):
+        return 0
+    env = os.environ.get("PREFIX_CACHE_BLOCKS", "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer PREFIX_CACHE_BLOCKS=%r", env)
+    return default
+
+
 class JaxChatEngine(ChatEngine):
     """One loaded model: tokenizer + continuous batcher. Concurrent chats
     join the shared fixed-width decode step; the batcher's dedicated owner
@@ -236,6 +251,7 @@ class LocalRegistry(Registry):
         kv_quant: str = "none",
         admit_queue_limit: int = 0,
         admit_max_age_ms: float = 0.0,
+        prefix_cache_blocks: int | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -251,6 +267,13 @@ class LocalRegistry(Registry):
         # submit, age sheds at admit — see ContinuousBatcher.max_queue
         self.admit_queue_limit = admit_queue_limit
         self.admit_max_age_ms = admit_max_age_ms
+        # per-engine prefix KV cache budget in chunk blocks (0 = off);
+        # None = read PREFIX_CACHE / PREFIX_CACHE_BLOCKS from the env
+        self.prefix_cache_blocks = (
+            prefix_cache_blocks
+            if prefix_cache_blocks is not None
+            else _prefix_cache_blocks_env()
+        )
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
         self._requests = 0
@@ -260,6 +283,10 @@ class LocalRegistry(Registry):
         # _pick_idle_victim)
         self._hbm_committed: dict[str, int] = {}
         self._last_used: dict[str, float] = {}
+        # slice of each engine's committed bytes that is its prefix cache's
+        # budget — reclaimable under pressure WITHOUT unloading the engine
+        # (_shrink_prefix_caches), unlike the weights/serving cache
+        self._prefix_bytes: dict[str, int] = {}
         self.evict_grace_s = 1.0
 
     # -- Registry ------------------------------------------------------------
@@ -293,6 +320,7 @@ class LocalRegistry(Registry):
     async def delete(self, model_id: str) -> str:
         eng = self._engines.pop(model_id, None)
         self._hbm_committed.pop(model_id, None)
+        self._prefix_bytes.pop(model_id, None)
         self._last_used.pop(model_id, None)
         if eng is not None:
             await eng.unload()
@@ -334,6 +362,7 @@ class LocalRegistry(Registry):
                 # device OOM) must not leave phantom committed bytes that
                 # refuse every future load until restart
                 self._hbm_committed.pop(cm.model_id, None)
+                self._prefix_bytes.pop(cm.model_id, None)
                 raise
             self._engines[cm.model_id] = eng
             self._last_used[cm.model_id] = time.monotonic()
@@ -369,8 +398,23 @@ class LocalRegistry(Registry):
                 "HBM estimate failed for %s; admitting with file-size floor "
                 "%d MiB (no eviction)", model_id, need >> 20, exc_info=True,
             )
+        pbytes = 0
+        if self.prefix_cache_blocks > 0:
+            try:
+                pbytes = await asyncio.to_thread(self._estimate_prefix_bytes, paths)
+            except Exception:  # noqa: BLE001 — cache stays block-bounded anyway
+                log.warning(
+                    "prefix-cache estimate failed for %s; admitting its cache "
+                    "unpriced", model_id, exc_info=True,
+                )
+        need += pbytes
         self._hbm_committed.pop(model_id, None)  # reloading: don't double count
+        self._prefix_bytes.pop(model_id, None)
         while sum(self._hbm_committed.values()) + need > budget:
+            # cheapest eviction tier first: dropping another engine's prefix
+            # cache frees its whole block budget without unloading anything
+            if evictable and self._shrink_prefix_caches(exclude=model_id):
+                continue
             victim = self._pick_idle_victim() if evictable else None
             if victim is None and evictable:
                 # an idle engine inside the eviction grace may become
@@ -390,12 +434,15 @@ class LocalRegistry(Registry):
                 )
             log.info("evicting idle engine %s to fit %s", victim, model_id)
             freed = self._hbm_committed.pop(victim, 0)
+            self._prefix_bytes.pop(victim, None)
             eng = self._engines.pop(victim)
             self._last_used.pop(victim, None)
             await eng.unload()
             obs_emit("engine_evict", model=victim, for_model=model_id,
                      freed_bytes=freed)
         self._hbm_committed[model_id] = need
+        if pbytes:
+            self._prefix_bytes[model_id] = pbytes
 
     def _estimate_load_bytes(self, paths: list[str]) -> int:
         """Per-device estimate for serving this file with the registry's
@@ -412,6 +459,49 @@ class LocalRegistry(Registry):
             cfg, mesh_shape, quant=self.quant, batch=self.max_batch_slots,
             seq_len=seq, cache_dtype_bytes=1 if self.kv_quant == "int8" else None,
         )["total"]
+
+    def _shrink_prefix_caches(self, exclude: str | None = None) -> bool:
+        """Reclaim HBM by dropping the least-recently-used engine's prefix
+        cache — no unload, serving state untouched; blocks pinned by an
+        in-flight admit are freed when that admit releases them (the
+        refcount contract in serve/prefix_cache.py). Returns True when
+        committed bytes decreased, so the admit loop retries the budget
+        check before escalating to whole-engine eviction."""
+        cands = [
+            mid for mid in self._engines
+            if mid != exclude and self._prefix_bytes.get(mid, 0) > 0
+        ]
+        if not cands:
+            return False
+        mid = min(cands, key=lambda m: self._last_used.get(m, 0.0))
+        eng = self._engines[mid]
+        freed = self._prefix_bytes.pop(mid, 0)
+        self._hbm_committed[mid] = max(0, self._hbm_committed.get(mid, 0) - freed)
+        dropped = eng.batcher.drop_prefix_cache() if eng.batcher is not None else 0
+        log.info(
+            "dropped %s prefix cache under HBM pressure (%d blocks, ~%d MiB)",
+            mid, dropped, freed >> 20,
+        )
+        obs_emit("prefix_cache_drop", model=mid, freed_bytes=freed, blocks=dropped)
+        return True
+
+    def _estimate_prefix_bytes(self, paths: list[str]) -> int:
+        """Worst-case device bytes of this engine's prefix-cache budget:
+        blocks x the block footprint at the chunk size the batcher will
+        actually serve with (serve/prefix_cache.serving_chunk mirrors the
+        batcher's chunk halving)."""
+        from .prefix_cache import prefix_block_bytes, serving_chunk
+
+        from ..gguf.reader import is_split_shard
+
+        split = sorted(p for p in paths if is_split_shard(p))
+        with open_gguf(split[0] if split else paths[0]) as reader:
+            cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(dtype=self.dtype)
+        seq = min(self.max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+        chunk = serving_chunk(seq)
+        return self.prefix_cache_blocks * prefix_block_bytes(
+            cfg, chunk, kv_quant=self.kv_quant
+        )
 
     def _pick_idle_victim(self) -> str | None:
         # grace window: an engine targeted within the last second is never
@@ -482,6 +572,7 @@ class LocalRegistry(Registry):
             params, cfg, max_slots=self.max_batch_slots, max_seq_len=self.max_seq_len,
             mesh=self.mesh, max_queue=self.admit_queue_limit,
             max_queue_age_ms=self.admit_max_age_ms,
+            prefix_cache_blocks=self.prefix_cache_blocks,
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time
@@ -517,4 +608,11 @@ class LocalRegistry(Registry):
         }
         if batchers:
             out["batcher"] = batchers
+        prefix = {
+            mid: eng.batcher.prefix_cache.stats()
+            for mid, eng in self._engines.items()
+            if eng.batcher is not None and eng.batcher.prefix_cache is not None
+        }
+        if prefix:
+            out["prefix_cache"] = prefix
         return out

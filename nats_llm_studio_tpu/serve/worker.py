@@ -464,6 +464,18 @@ class Worker:
                           labels={**labels, "cause": cause})
             for name, h in stats.histograms().items():
                 r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
+            pcache = getattr(eng.batcher, "prefix_cache", None)
+            if pcache is not None:
+                # two new families: lmstudio_prefix_cache_*_total counters
+                # (hits/misses/full_hits/hit_tokens/inserted/evicted blocks)
+                # and the lmstudio_prefix_hit_tokens histogram, plus
+                # residency gauges — the cache's whole serving story
+                for name, v in pcache.counters().items():
+                    r.counter(f"lmstudio_prefix_cache_{name}_total", v, labels=labels)
+                r.gauge("lmstudio_prefix_cache_blocks", pcache.blocks, labels=labels)
+                r.gauge("lmstudio_prefix_cache_bytes", pcache.bytes, labels=labels)
+                r.histogram("lmstudio_prefix_hit_tokens",
+                            pcache.hit_tokens_hist.snapshot(), labels=labels)
         return r.render()
 
     async def on_metrics_prom(self, msg: Msg) -> None:

@@ -1,0 +1,265 @@
+"""Prefix KV cache tests: cached-prefix admits must reproduce single-stream
+generation exactly (full hit, partial hit at a non-chunk boundary, quantized
+KV blocks, eviction pressure), PREFIX_CACHE off must leave the batcher
+byte-identical to the uncached path, and refcounted eviction must never free
+a block an in-flight admit is still reading."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nats_llm_studio_tpu.engine.generator import Generator, SamplingParams
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+from nats_llm_studio_tpu.serve.prefix_cache import (
+    PrefixCache,
+    prefix_block_bytes,
+    serving_chunk,
+)
+
+from conftest import async_test
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def kvq_model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64, kv_quant="int8")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def reference_greedy(cfg, params, prompt, n):
+    gen = Generator(params, cfg, max_seq_len=64, buckets=[8, 16, 32, 64])
+    sp = SamplingParams(temperature=0.0, max_tokens=n)
+    return [t for t, _ in gen.generate(prompt, sp)]
+
+
+def make_batcher(params, cfg, blocks):
+    return ContinuousBatcher(
+        params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64],
+        prefill_chunk=8, prefix_cache_blocks=blocks,
+    )
+
+
+async def _greedy(b, prompt, n):
+    sp = SamplingParams(temperature=0.0, max_tokens=n)
+    return [t async for t in b.submit(prompt, sp)]
+
+
+# -- serving equivalence ------------------------------------------------------
+
+
+@async_test
+async def test_full_hit_matches_reference(model):
+    """Resending a chunk-aligned prompt takes the full-hit path (first token
+    sampled from stored chunk-end logits, NO prefill) and must still match
+    the single-stream greedy reference."""
+    cfg, params = model
+    prompt = [(i * 7 + 3) % cfg.vocab_size for i in range(16)]  # 2 chunks
+    want = reference_greedy(cfg, params, prompt, 6)
+    b = make_batcher(params, cfg, blocks=8)
+    try:
+        assert await _greedy(b, prompt, 6) == want  # miss: populates
+        assert await _greedy(b, prompt, 6) == want  # full hit
+        c = b.prefix_cache.counters()
+        assert c["full_hits"] >= 1
+        assert c["hit_tokens"] >= 16
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_partial_hit_non_chunk_boundary_matches_reference(model):
+    """Two prompts sharing an 11-token prefix (chunk 8: one shared block,
+    shared region ending MID-chunk) — the second admit must resume prefill
+    from the chunk edge and match the reference exactly."""
+    cfg, params = model
+    shared = [(i * 5 + 1) % cfg.vocab_size for i in range(11)]
+    p1 = shared + [(i * 3 + 2) % cfg.vocab_size for i in range(9)]
+    p2 = shared + [(i * 11 + 4) % cfg.vocab_size for i in range(7)]
+    want1 = reference_greedy(cfg, params, p1, 5)
+    want2 = reference_greedy(cfg, params, p2, 5)
+    b = make_batcher(params, cfg, blocks=8)
+    try:
+        assert await _greedy(b, p1, 5) == want1
+        assert await _greedy(b, p2, 5) == want2
+        c = b.prefix_cache.counters()
+        assert c["hits"] >= 1
+        assert c["hit_tokens"] >= 8  # exactly the one shared full chunk
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_kv_quant_hit_matches_reference(kvq_model):
+    """With an int8 serving cache the cached blocks are KVQ codes+scales; a
+    hit re-installs the exact quantized values a prefill would have written,
+    so greedy output stays bit-identical to the (quantized) reference."""
+    cfg, params = kvq_model
+    prompt = [(i * 7 + 3) % cfg.vocab_size for i in range(19)]
+    want = reference_greedy(cfg, params, prompt, 6)
+    b = make_batcher(params, cfg, blocks=8)
+    try:
+        assert await _greedy(b, prompt, 6) == want
+        assert await _greedy(b, prompt, 6) == want
+        assert b.prefix_cache.counters()["hits"] >= 1
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_eviction_under_pressure_stays_correct(model):
+    """A 2-block budget under three distinct 2-chunk prompts must evict —
+    and every admit (hit, miss, post-eviction re-miss) must still match the
+    reference."""
+    cfg, params = model
+    prompts = [
+        [(i * 7 + 3) % cfg.vocab_size for i in range(16)],
+        [(i * 5 + 1) % cfg.vocab_size for i in range(16)],
+        [(i * 11 + 4) % cfg.vocab_size for i in range(16)],
+    ]
+    want = [reference_greedy(cfg, params, p, 4) for p in prompts]
+    b = make_batcher(params, cfg, blocks=2)
+    try:
+        for p, w in zip(prompts, want):
+            assert await _greedy(b, p, 4) == w
+        # first prompt's blocks were evicted; resending must still be correct
+        assert await _greedy(b, prompts[0], 4) == want[0]
+        pc = b.prefix_cache
+        assert pc.counters()["evicted_blocks"] > 0
+        assert pc.blocks <= 2
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_cache_off_matches_reference(model):
+    """prefix_cache_blocks=0 (the PREFIX_CACHE=0 off-switch) disables the
+    cache entirely: no PrefixCache object, outputs identical to the
+    reference for repeated long prompts."""
+    cfg, params = model
+    prompt = [(i * 7 + 3) % cfg.vocab_size for i in range(16)]
+    want = reference_greedy(cfg, params, prompt, 6)
+    b = make_batcher(params, cfg, blocks=0)
+    try:
+        assert b.prefix_cache is None
+        assert await _greedy(b, prompt, 6) == want
+        assert await _greedy(b, prompt, 6) == want
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_concurrent_hit_and_miss_group(model):
+    """A hit-bearing long prompt arriving alongside a fresh long prompt:
+    group formation routes the hit to the singleton hit path while the miss
+    still admits (possibly grouped) — both must match the reference."""
+    cfg, params = model
+    p_hit = [(i * 7 + 3) % cfg.vocab_size for i in range(25)]
+    p_miss = [(i * 5 + 1) % cfg.vocab_size for i in range(30)]
+    want_hit = reference_greedy(cfg, params, p_hit, 5)
+    want_miss = reference_greedy(cfg, params, p_miss, 5)
+    b = make_batcher(params, cfg, blocks=8)
+    try:
+        assert await _greedy(b, p_hit, 5) == want_hit  # populate
+        tasks = [
+            asyncio.create_task(_greedy(b, p_hit, 5)),
+            asyncio.create_task(_greedy(b, p_miss, 5)),
+        ]
+        await asyncio.sleep(0)
+        got_hit, got_miss = await asyncio.gather(*tasks)
+        assert got_hit == want_hit
+        assert got_miss == want_miss
+        assert b.prefix_cache.counters()["hits"] >= 1
+    finally:
+        b.stop()
+
+
+# -- cache-structure unit tests (no model) ------------------------------------
+
+
+def _blk(v, chunk=4):
+    a = jnp.full((1, 2, 1, chunk, 2), float(v))
+    return jnp.copy(a), jnp.copy(a)
+
+
+def test_refcount_protects_pinned_blocks_across_eviction():
+    """Evicting a pinned node must detach it from the tree WITHOUT freeing
+    its arrays — the in-flight admit that pinned them is still issuing copy
+    dispatches. release() then frees the dead node."""
+    pc = PrefixCache(chunk=4, capacity_blocks=2)
+    p1 = list(range(8))  # 2 chunks
+    assert pc.insert(p1, [_blk(1), _blk(2)]) == 2
+    # query longer than the cached path so BOTH nodes stay in the hit
+    hit = pc.match(p1 + [91, 92, 93, 94])
+    assert hit is not None and hit.tokens == 8 and len(hit.nodes) == 2
+    pinned = list(hit.nodes)
+
+    # capacity pressure from a different prompt evicts the pinned path
+    pc.insert(list(range(100, 108)), [_blk(3), _blk(4)])
+    assert pc.counters()["evicted_blocks"] >= 2
+    assert pc.blocks <= 2
+    for nd in pinned:
+        assert nd.dead, "evicted-while-pinned node must be marked dead"
+        assert nd.kb is not None and nd.vb is not None, (
+            "eviction freed a block an active admit still reads"
+        )
+    # the detached path is gone from lookup
+    assert pc.match(p1 + [91]) is None
+
+    pc.release(hit)
+    for nd in pinned:
+        assert nd.kb is None and nd.vb is None, "release must free dead nodes"
+    assert hit.nodes == []
+
+
+def test_full_coverage_needs_end_logits():
+    """A match covering the whole prompt is only a FULL hit when the last
+    node stored its chunk-end logits; otherwise the final chunk is dropped
+    so the batcher re-prefills it (and backfills the logits)."""
+    pc = PrefixCache(chunk=4, capacity_blocks=8)
+    p = list(range(8))
+    pc.insert(p, [_blk(1), _blk(2)])  # harvested without logits
+    hit = pc.match(p)
+    assert hit is not None and hit.tokens == 4  # last chunk dropped
+    assert hit.end_logits is None
+    pc.release(hit)
+
+    # backfill pass: same path re-inserted with logits on the final chunk
+    pc.insert(p, [None, None], logits_list=[None, jnp.zeros((1, 1, 16))])
+    hit = pc.match(p)
+    assert hit is not None and hit.tokens == 8
+    assert hit.end_logits is not None
+    pc.release(hit)
+
+
+def test_resize_zero_drops_everything_and_disables_insert():
+    pc = PrefixCache(chunk=4, capacity_blocks=8)
+    pc.insert(list(range(8)), [_blk(1), _blk(2)])
+    assert pc.blocks == 2
+    assert pc.resize(0) == 2
+    assert pc.blocks == 0 and pc.bytes == 0
+    assert pc.insert(list(range(8)), [_blk(1), _blk(2)]) == 0  # capacity 0
+
+
+def test_block_bytes_estimate_covers_measured_blocks():
+    """The registry prices HBM with prefix_block_bytes; a real block pair
+    must never exceed the estimate (underestimating would oversubscribe
+    admission)."""
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    chunk = serving_chunk(64, 8)
+    pc = PrefixCache(chunk=chunk, capacity_blocks=4)
+    k = jnp.zeros((1, cfg.n_layers, cfg.n_kv_heads, chunk, cfg.head_dim),
+                  jnp.float32)
+    pc.insert(list(range(chunk)), [(k, jnp.copy(k))])
+    est = prefix_block_bytes(cfg, chunk)
+    assert pc.bytes <= est
