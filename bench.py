@@ -857,13 +857,14 @@ def _drive_engine(cfg, params, model_id, tokenizer, batcher, body_fn):
         nc = await connect(broker.url)
 
         async def one_chat(tag: int, prompt: str, max_tokens: int,
-                           gaps: list | None = None):
+                           gaps: list | None = None,
+                           temperature: float = 0.8):
             body = json.dumps(
                 {
                     "model": model_id,
                     "messages": [{"role": "user", "content": prompt}],
                     "max_tokens": max_tokens,
-                    "temperature": 0.8,
+                    "temperature": temperature,
                     "seed": tag,
                     "stream": True,
                 }
@@ -1206,6 +1207,150 @@ def prefix_cache_bench(cfg, params, model_id: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding: prompt-lookup drafts, spec ON vs OFF
+# ---------------------------------------------------------------------------
+
+
+def make_incompressible_prompt(n_tokens: int, seed: int = 3) -> str:
+    """~n_tokens of pseudo-random ASCII letters: no repeated n-gram for the
+    prompt-lookup index to hit (the adversarial mix for spec decoding)."""
+    import random as _random
+
+    r = _random.Random(seed)
+    letters = "abcdefghijklmnopqrstuvwxyz "
+    return "".join(r.choice(letters) for _ in range(n_tokens))
+
+
+def spec_decode_bench(cfg, params, model_id: str, *, seq: int | None = None,
+                      n_reqs: int | None = None, max_new: int | None = None,
+                      spec_k: int | None = None) -> dict:
+    """Low-occupancy serving with speculative decoding ON vs OFF
+    (serve/spec.py): two prompt mixes — repetition-heavy (greedy; the
+    n-gram index hits, drafts accept, decode skips ahead) and
+    incompressible (sampled; near-zero hits, measures the overhead floor)
+    — each served on spec-on and spec-off engines of identical geometry.
+    Reports client-side decode tok/s and TTFT p50 per mode plus the
+    drafted/accepted counters scraped off the worker's Prometheus
+    exposition (proving the acceptance rate on the wire). Spec-on must
+    beat spec-off on the repetition mix at low batch; the incompressible
+    mix bounds the regression when drafting never pays."""
+    import asyncio
+
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+    tokenizer = _make_bench_tokenizer(cfg)
+    seq = seq or int(os.environ.get("BENCH_SPEC_SEQ", "1024"))
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", "4"))
+    n_reqs = n_reqs or int(os.environ.get("BENCH_SPEC_REQS", "8"))
+    max_new = max_new or int(os.environ.get("BENCH_SPEC_NEW", "96"))
+    spec_k = spec_k or int(os.environ.get("BENCH_SPEC_K", "6"))
+    prompt_len = min(max(64, seq // 4), seq - max_new - 2 * (spec_k + 1))
+
+    # repetition-heavy: a looped phrase (the byte-level bench tokenizer
+    # turns the repeats into recurring token n-grams) decoded GREEDILY, so
+    # generated continuations recur too; incompressible: random letters,
+    # sampled at temperature 0.8
+    rep_prompt = make_long_prompt(prompt_len)
+    inc_prompt = make_incompressible_prompt(prompt_len)
+    mixes = [("repetition", rep_prompt, 0.0), ("incompressible", inc_prompt, 0.8)]
+
+    def run_mode(k: int, mix_name: str, prompt: str, temperature: float) -> dict:
+        batcher = ContinuousBatcher(
+            params, cfg, max_slots=slots, max_seq_len=seq,
+            buckets=[b for b in (256, 512) if b < seq] + [seq],
+            spec_decode_k=k, spec_max_active=slots,
+        )
+
+        async def body(nc, one_chat):
+            # warm admit/decode/verify programs outside the timed window —
+            # same prompt shape (same prefill bucket) and same generation
+            # length (same decode/verify window ladder) as the measured
+            # requests, or their compiles land inside the window
+            await one_chat(800, f"{prompt} [req 800]", max_new,
+                           temperature=temperature)
+            if k > 0:
+                # a greedy repetition-heavy chat reliably drafts, forcing
+                # the verify program to compile here even when THIS mix
+                # rarely proposes (the incompressible warm chat may never
+                # hit, leaving spec_verify cold)
+                await one_chat(801, f"{rep_prompt} [req 801]", max_new,
+                               temperature=0.0)
+            s0 = batcher.stats.snapshot()
+            h0 = _phase_hists(batcher)
+            t0 = time.perf_counter()
+            sem = asyncio.Semaphore(slots)
+
+            async def one(i: int):
+                async with sem:
+                    # unique suffix so admits don't collapse into the
+                    # prefix cache; the shared body still feeds the n-gram
+                    # index
+                    return await one_chat(
+                        1000 + i, f"{prompt} [req {i:03d}]", max_new,
+                        temperature=temperature,
+                    )
+
+            reqs = await asyncio.gather(*[one(i) for i in range(n_reqs)])
+            wall = time.perf_counter() - t0
+            phase = _phase_delta(batcher, s0, h0)
+            ttfts = sorted(r["ttft_s"] * 1e3 for r in reqs
+                           if r["ttft_s"] == r["ttft_s"])
+            decode_tok = sum(max(0, r["completion_tokens"] - 1) for r in reqs)
+            decode_s = sum(r["wall_s"] - r["ttft_s"] for r in reqs
+                           if r["ttft_s"] == r["ttft_s"])
+            out = {
+                "requests": n_reqs,
+                "completion_tokens": sum(r["completion_tokens"] for r in reqs),
+                "ttft_p50_ms": round(_pctl(ttfts, 0.5), 1),
+                "decode_tok_s": (
+                    round(decode_tok / decode_s, 1) if decode_s > 0 else 0.0
+                ),
+                "wall_s": round(wall, 2),
+                "parse_failures": sum(1 for r in reqs if r["parse_fail"]),
+                "batcher_phase": phase,
+            }
+            s1 = batcher.stats.snapshot()
+            out["verifies"] = s1["spec_verifies"] - s0["spec_verifies"]
+            drafted = s1["spec_drafted"] - s0["spec_drafted"]
+            accepted = s1["spec_accepted"] - s0["spec_accepted"]
+            out["drafted"] = drafted
+            out["accepted"] = accepted
+            if drafted:
+                out["accept_rate"] = round(accepted / drafted, 3)
+            if k > 0:
+                try:  # prove the counters on the wire, not just in-process
+                    reply = await nc.request("lmstudio.metrics.prom", b"",
+                                             timeout=30.0)
+                    for ln in reply.payload.decode().splitlines():
+                        if ln.startswith(("lmstudio_spec_drafted_total",
+                                          "lmstudio_spec_accepted_total")):
+                            out.setdefault("prom_lines", []).append(ln)
+                except Exception:  # noqa: BLE001 — exposition is best-effort
+                    pass
+            return out
+
+        out = _drive_engine(cfg, params, model_id, tokenizer, batcher, body)
+        gc.collect()
+        return out
+
+    result: dict = {"max_seq_len": seq, "slots": slots, "spec_k": spec_k,
+                    "max_new": max_new}
+    for mix_name, prompt, temperature in mixes:
+        on = run_mode(spec_k, mix_name, prompt, temperature)
+        off = run_mode(0, mix_name, prompt, temperature)
+        result[mix_name] = {
+            "temperature": temperature,
+            "spec_on": on,
+            "spec_off": off,
+            "decode_speedup": (
+                round(on["decode_tok_s"] / off["decode_tok_s"], 2)
+                if off["decode_tok_s"] else 0.0
+            ),
+        }
+    return result
+
+
+# ---------------------------------------------------------------------------
 
 
 def _print_final(obj: dict) -> None:
@@ -1229,12 +1374,21 @@ def main() -> None:
 
         params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
         r = decode_bench(cfg, params, batch=2, prompt_len=16, seq_len=64, steps=8)
+        tiny_detail = {"quant": cfg.dtype, "platform": detail["platform"],
+                       "tiny": r}
+        if os.environ.get("BENCH_SPEC", "1") != "0":
+            try:  # micro-run of the spec phase (CI smoke coverage)
+                tiny_detail["spec_decode"] = spec_decode_bench(
+                    cfg, params, "bench/tiny",
+                    seq=256, n_reqs=2, max_new=24, spec_k=4,
+                )
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                tiny_detail["spec_decode_error"] = f"{type(e).__name__}: {e}"
         _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
             "vs_baseline": 0.0,
-            "detail": {"quant": cfg.dtype, "platform": detail["platform"],
-                       "tiny": r},
+            "detail": tiny_detail,
         })
         return
 
@@ -1341,6 +1495,16 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001 — report, don't die
             detail["prefix_cache_error"] = f"{type(e).__name__}: {e}"
+        gc.collect()
+
+    # -- speculative decoding: prompt-lookup drafts, ON vs OFF ---------------
+    if os.environ.get("BENCH_SPEC", "1") != "0":
+        try:
+            detail["spec_decode"] = spec_decode_bench(
+                cfg, params, "bench/llama3-8b"
+            )
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            detail["spec_decode_error"] = f"{type(e).__name__}: {e}"
         gc.collect()
 
     del params

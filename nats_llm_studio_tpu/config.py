@@ -74,12 +74,29 @@ class WorkerConfig:
     prefix_cache_blocks: int = field(
         default_factory=lambda: int(_env("PREFIX_CACHE_BLOCKS", "64"))
     )
+    # speculative decoding (serve/spec.py): max prompt-lookup draft tokens
+    # per slot per verify dispatch. SPEC_DECODE=0 is the hard off-switch
+    # (wins over SPEC_DECODE_K); SPEC_DECODE_K=0 also disables. NOTE: k > 0
+    # runs the engine cache in positional layout (per-row scatter writes),
+    # trading some high-occupancy ring throughput for the low-occupancy
+    # speculative win — throughput-tuned high-batch deployments should set
+    # SPEC_DECODE=0.
+    spec_decode_k: int = field(
+        default_factory=lambda: int(_env("SPEC_DECODE_K", "6"))
+    )
+    # verify dispatches pause above this many live slots (decode turns
+    # compute-bound and drafts stop paying); plain decode continues
+    spec_max_active: int = field(
+        default_factory=lambda: int(_env("SPEC_DECODE_MAX_ACTIVE", "4"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count
             self.admit_queue_limit = 4 * self.max_batch_slots
         if _env("PREFIX_CACHE", "").strip().lower() in ("0", "false", "off"):
             self.prefix_cache_blocks = 0
+        if _env("SPEC_DECODE", "").strip().lower() in ("0", "false", "off"):
+            self.spec_decode_k = 0
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

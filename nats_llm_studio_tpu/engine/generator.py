@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from ..models.config import ModelConfig
 from ..models.llama import forward, make_cache
-from .sampling import sample
+from .sampling import sample, sample_rows, spec_accept_rows
 
 
 def default_buckets(max_seq: int, start: int = 32) -> list[int]:
@@ -104,8 +104,35 @@ class Generator:
             next_tok = sample(logits[:, -1, :], key, temperature, top_k, top_p)
             return next_tok, k_cache, v_cache
 
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def decode_rows_fn(params, token, k_cache, v_cache, pos, seed, step,
+                           temperature, top_k, top_p):
+            """Decode step on the (seed, step) counter streams the batcher
+            uses (sampling.sample_rows) — the speculative reference loop
+            must consume the SAME rng streams as the serving path to be
+            token-comparable at temperature > 0."""
+            logits, k_cache, v_cache = fwd(params, tokens=token, k_cache=k_cache,
+                                           v_cache=v_cache, start_pos=pos)
+            next_tok = sample_rows(logits[:, -1, :], seed, step, temperature,
+                                   top_k, top_p)
+            return next_tok, k_cache, v_cache
+
+        @partial(jax.jit, donate_argnums=(2, 3))
+        def spec_verify_fn(params, toks_in, k_cache, v_cache, pos, drafts,
+                           dlen, seed, step, temperature, top_k, top_p):
+            """Reference verify: one width-(k+1) forward through the
+            positional cache-write path + the rejection-sampling acceptance
+            rule — the single-stream mirror of the batcher's program."""
+            logits, k_cache, v_cache = fwd(params, tokens=toks_in, k_cache=k_cache,
+                                           v_cache=v_cache, start_pos=pos)
+            out, n_emit = spec_accept_rows(logits, drafts, dlen, seed, step,
+                                           temperature, top_k, top_p)
+            return out, n_emit, k_cache, v_cache
+
         self._prefill = prefill_fn
         self._decode = decode_fn
+        self._decode_rows = decode_rows_fn
+        self._spec_verify = spec_verify_fn
 
     # -- shape management ----------------------------------------------------
 
@@ -123,12 +150,16 @@ class Generator:
             tokens = jnp.zeros((batch, b), jnp.int32)
             logits, k, v = self._prefill(self.params, tokens, k, v, jnp.zeros((batch,), jnp.int32))
             tok = jnp.zeros((batch, 1), jnp.int32)
-            self._decode(
+            nxt, k, v = self._decode(
                 self.params, tok, k, v,
                 jnp.full((batch,), b, jnp.int32), jax.random.PRNGKey(0),
                 jnp.ones((batch,)), jnp.zeros((batch,), jnp.int32), jnp.ones((batch,)),
             )
-        jax.block_until_ready(logits)
+            # block EVERY bucket's prefill and its decode output inside the
+            # loop: one block on the final prefill's logits let the other
+            # buckets' compiles (and all decode executions) finish after
+            # the timer, so the returned compile-seconds undercounted
+            jax.block_until_ready((logits, nxt))
         return time.perf_counter() - t0
 
     # -- generation ----------------------------------------------------------
@@ -209,3 +240,109 @@ class Generator:
         stats.total_s = time.perf_counter() - t_start
         if trace is not None:
             trace.mark("decode_done")
+
+    def generate_speculative(
+        self,
+        prompt_ids: list[int],
+        sp: SamplingParams | None = None,
+        spec_k: int = 6,
+        max_ngram: int = 3,
+        min_ngram: int = 1,
+    ) -> Iterator[tuple[int, GenStats]]:
+        """REFERENCE prompt-lookup speculative loop (single stream).
+
+        Same proposal source (serve.spec.NGramIndex), same acceptance rule
+        (sampling.spec_accept_rows) and the same per-(seed, step) rng
+        streams as the speculative batcher: first token at step 0, a
+        verify consumes steps [s, s + k] and advances by k + 1, a plain
+        fallback step consumes one. Greedy output is bit-identical to
+        ``generate()``; a single-request speculative batcher with
+        ``decode_burst=1`` and the same seed/k/ngram settings is
+        token-identical at ANY temperature (with a wider burst the two
+        re-propose at different points, so temperature > 0 streams align
+        only in distribution). The batcher's equivalence tests hold it to
+        this loop."""
+        from ..serve.spec import NGramIndex  # deferred: serve imports engine
+
+        sp = sp or SamplingParams()
+        n = len(prompt_ids)
+        if n == 0:
+            return
+        if n >= self.max_seq:
+            raise ValueError(f"prompt of {n} tokens >= max_seq_len {self.max_seq}")
+        bucket = self.bucket_for(n)
+        stats = GenStats(prompt_tokens=n)
+        t_start = time.perf_counter()
+
+        tokens = jnp.asarray([prompt_ids + [0] * (bucket - n)], jnp.int32)
+        k_cache, v_cache = make_cache(self.cfg, 1, self.max_seq)
+        logits, k_cache, v_cache = self._prefill(
+            self.params, tokens, k_cache, v_cache, jnp.zeros((1,), jnp.int32)
+        )
+        seed = sp.seed if sp.seed is not None else time.monotonic_ns() % 2**31
+        seed_a = jnp.full((1,), seed, jnp.int32)
+        temp = jnp.full((1,), sp.temperature, jnp.float32)
+        tk = jnp.full((1,), sp.top_k, jnp.int32)
+        tp = jnp.full((1,), sp.top_p, jnp.float32)
+        first = sample_rows(
+            logits[:, n - 1, :], seed_a, jnp.zeros((1,), jnp.int32), temp, tk, tp
+        )
+
+        index = NGramIndex(list(prompt_ids), max_ngram, min_ngram)
+        index.append(int(first[0]))
+        pos = n  # the carry token (index tail) is sequence index pos
+        step = 1  # rng step counter; the first token consumed step 0
+        max_new = min(sp.max_tokens, self.max_seq - n)
+        emitted = 0
+        queue = [int(first[0])]  # sampled, not yet yielded
+        done = False
+        while not done:
+            while queue:
+                tok_id = queue.pop(0)
+                if emitted == 0:
+                    stats.ttft_s = time.perf_counter() - t_start
+                if tok_id in sp.stop_ids:
+                    done = True
+                    break
+                emitted += 1
+                stats.completion_tokens += 1
+                stats.total_s = time.perf_counter() - t_start
+                yield tok_id, stats
+                if emitted >= max_new:
+                    done = True
+                    break
+            if done:
+                break
+            carry = jnp.asarray([[index.hist[-1]]], jnp.int32)
+            drafts = (
+                index.propose(spec_k)
+                if pos + spec_k + 1 < self.max_seq  # mirror the batcher guard
+                else []
+            )
+            if drafts:
+                pad = list(drafts) + [0] * (spec_k - len(drafts))
+                out, n_emit, k_cache, v_cache = self._spec_verify(
+                    self.params,
+                    jnp.concatenate([carry, jnp.asarray([pad], jnp.int32)], axis=1),
+                    k_cache, v_cache,
+                    jnp.full((1,), pos, jnp.int32),
+                    jnp.asarray([pad], jnp.int32),
+                    jnp.asarray([len(drafts)], jnp.int32),
+                    seed_a, jnp.full((1,), step, jnp.int32), temp, tk, tp,
+                )
+                ne = int(n_emit[0])
+                news = [int(x) for x in out[0, :ne]]
+                step += spec_k + 1
+                pos += ne
+            else:
+                nxt, k_cache, v_cache = self._decode_rows(
+                    self.params, carry, k_cache, v_cache,
+                    jnp.full((1,), pos, jnp.int32),
+                    seed_a, jnp.full((1,), step, jnp.int32), temp, tk, tp,
+                )
+                news = [int(nxt[0])]
+                step += 1
+                pos += 1
+            index.extend(news)
+            queue.extend(news)
+        stats.total_s = time.perf_counter() - t_start

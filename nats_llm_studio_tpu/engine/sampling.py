@@ -90,3 +90,122 @@ def sample_rows(
 
     gumbel = jax.vmap(row_gumbel)(seeds, steps)
     return _pick(logits, gumbel, temperature, top_k, top_p)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding: rejection-sampling acceptance (serve/spec.py design)
+# ---------------------------------------------------------------------------
+
+
+def _log_weights(logits, temperature, top_k, top_p) -> jax.Array:
+    """Full-vocab log-weights ``w`` with softmax(w) equal to the
+    distribution ``_pick`` draws from for temperature > 0 rows — same
+    CANDIDATES cap, same top-k/top-p truncation rules, token for token.
+    Non-selectable tokens sit at -inf. Greedy rows (temperature <= 0) are
+    the caller's job: their "distribution" is a point mass at argmax."""
+    b, v = logits.shape
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+
+    c = min(CANDIDATES, v)
+    cand, cand_idx = jax.lax.top_k(logits, c)
+    ranks = jnp.arange(c)[None, :]
+    k_eff = jnp.where(top_k <= 0, c, jnp.minimum(top_k, c))[:, None]
+    keep = ranks < k_eff
+    probs = jax.nn.softmax(cand / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_p[:, None]
+    # scatter the kept candidates back onto the full vocab axis
+    rows = jnp.arange(b)[:, None]
+    masked = jnp.full((b, v), _NEG_INF).at[rows, cand_idx].set(
+        jnp.where(keep, cand / safe_t, _NEG_INF)
+    )
+    restricted = (((top_k > 0) & (top_k < v)) | (top_p < 1.0))[:, None]
+    return jnp.where(restricted, masked, logits / safe_t)
+
+
+def spec_accept_rows(
+    logits: jax.Array,  # [B, T, V] f32 — verify logits, T = k + 1
+    drafts: jax.Array,  # [B, k] int32 — proposed draft tokens
+    draft_len: jax.Array,  # [B] int32 — valid drafts per row (0..k)
+    seeds: jax.Array,  # [B] int32 — per-row PRNG seed (sample_rows contract)
+    steps: jax.Array,  # [B] int32 — per-row step counter at verify position 0
+    temperature: jax.Array | float = 0.8,
+    top_k: jax.Array | int = 0,
+    top_p: jax.Array | float = 1.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Rejection-sampling acceptance for prompt-lookup drafts.
+
+    Position j's model distribution is ``p_j`` = what the plain sampler
+    would draw from (``_log_weights``; point mass at argmax for greedy
+    rows). The draft proposal is DETERMINISTIC (a point mass at d_j), so
+    the Leviathan et al. rule collapses to: accept d_j with probability
+    p_j(d_j); on the first rejection, resample from p_j with d_j removed
+    and renormalized (the residual (p - min(p, q))+ for a point-mass q);
+    when every valid draft is accepted, the bonus token is a PLAIN sample
+    from the last position. Each emitted token is therefore distributed
+    exactly as the plain sampler's — greedy rows degenerate to "accept
+    while the draft equals argmax", which makes greedy output bit-identical
+    to non-speculative decoding.
+
+    Randomness: position j consumes the (seeds, steps + j) stream, split
+    into an acceptance uniform (fold_in 0) and a residual/bonus Gumbel
+    (fold_in 1) — independent per position, independent of batch
+    composition. Callers advance the step counter by T per verify.
+
+    Returns ``(tokens [B, T], n_emit [B])``: row b's emitted tokens are
+    ``tokens[b, :n_emit[b]]`` (accepted drafts then the resampled/bonus
+    token); positions past n_emit hold zeros and carry no meaning.
+    """
+    b, t, v = logits.shape
+    kd = t - 1
+    temp_b = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+
+    def pos_streams(seed, step):
+        def one(j):
+            kj = jax.random.fold_in(jax.random.PRNGKey(seed), step + j)
+            u = jax.random.uniform(jax.random.fold_in(kj, 0))
+            g = jax.random.gumbel(jax.random.fold_in(kj, 1), (v,), jnp.float32)
+            return u, g
+
+        return jax.vmap(one)(jnp.arange(t, dtype=jnp.int32))
+
+    u, gumbel = jax.vmap(pos_streams)(seeds, steps)  # [B,T], [B,T,V]
+    w = jax.vmap(
+        _log_weights, in_axes=(1, None, None, None), out_axes=1
+    )(logits, temp_b, top_k, top_p)  # [B, T, V]
+    p = jax.nn.softmax(w, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+
+    # acceptance over the kd draft positions
+    p_draft = jnp.take_along_axis(p[:, :kd], drafts[..., None], axis=-1)[..., 0]
+    is_greedy = (temp_b <= 0.0)[:, None]
+    ok = jnp.where(is_greedy, drafts == greedy_tok[:, :kd], u[:, :kd] < p_draft)
+    ok &= jnp.arange(kd, dtype=jnp.int32)[None, :] < draft_len[:, None]
+    # accepted = length of the all-accepted prefix
+    a = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [B] in 0..kd
+
+    # the one extra token, from position a: a rejection resamples the
+    # residual (draft token masked out); full acceptance samples plainly
+    w_a = jnp.take_along_axis(w, a[:, None, None], axis=1)[:, 0]  # [B, V]
+    g_a = jnp.take_along_axis(gumbel, a[:, None, None], axis=1)[:, 0]
+    greedy_a = jnp.take_along_axis(greedy_tok, a[:, None], axis=1)[:, 0]
+    d_a = jnp.take_along_axis(
+        drafts, jnp.minimum(a, kd - 1)[:, None], axis=1
+    )[:, 0]
+    rejected = a < draft_len
+    w_res = jnp.where(
+        rejected[:, None] & (jnp.arange(v)[None, :] == d_a[:, None]),
+        _NEG_INF,
+        w_a,
+    )
+    pick = jnp.argmax(w_res + g_a, axis=-1)
+    extra = jnp.where(temp_b <= 0.0, greedy_a, pick).astype(jnp.int32)
+
+    j = jnp.arange(t, dtype=jnp.int32)[None, :]
+    drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+    out = jnp.where(j < a[:, None], drafts_pad, 0)
+    out = jnp.where(j == a[:, None], extra[:, None], out).astype(jnp.int32)
+    return out, (a + 1).astype(jnp.int32)

@@ -34,11 +34,12 @@ import numpy as np
 from ..engine.generator import SamplingParams, default_buckets
 from ..models.config import ModelConfig
 from ..models.llama import forward, make_cache
-from ..engine.sampling import sample_rows
+from ..engine.sampling import sample_rows, spec_accept_rows
 from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
 from ..ops.kvcache import kv_copy_slice, kv_gather_block, kv_roll_s, kv_slice
 from .prefix_cache import PrefixCache
+from .spec import SpecConfig, SpecSlot, make_slot
 
 log = logging.getLogger(__name__)
 
@@ -94,6 +95,11 @@ class BatcherStats:
     ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
     cancelled: int = 0  # consumer-gone requests whose slot/queue entry was freed
     shed: int = 0  # requests rejected at the depth bound or dropped at the age bound
+    # speculative decoding (serve/spec.py): drafted = n-gram tokens sent to
+    # verify dispatches, accepted = drafts the model's own distribution kept
+    spec_verifies: int = 0  # width-(k+1) verify dispatches
+    spec_drafted: int = 0
+    spec_accepted: int = 0
     # bounded log-bucket histograms (obs/histogram.py): O(1) record on the
     # batcher owner thread, O(buckets) snapshot from the asyncio metrics
     # handlers, fixed memory for the life of the worker. Phase deltas come
@@ -104,6 +110,11 @@ class BatcherStats:
     decode_step_ms: LogHistogram = field(default_factory=LogHistogram)  # per burst step
     tokens_per_step: LogHistogram = field(
         default_factory=lambda: LogHistogram(lo=1.0, hi=4096.0, growth=1.25)
+    )
+    # per-verify fraction of drafted tokens accepted; 0 is clamped to the
+    # bottom bucket (LogHistogram needs lo > 0)
+    spec_accept_rate: LogHistogram = field(
+        default_factory=lambda: LogHistogram(lo=0.01, hi=1.0, growth=1.25)
     )
     shed_causes: dict = field(default_factory=dict)  # "depth" | "age" -> count
     cancel_causes: dict = field(default_factory=dict)  # where the cancel landed
@@ -146,6 +157,16 @@ class BatcherStats:
             "prefill_ms": self.prefill_ms,
             "decode_step_ms": self.decode_step_ms,
             "tokens_per_step": self.tokens_per_step,
+            "spec_accept_rate": self.spec_accept_rate,
+        }
+
+    def spec_counters(self) -> dict[str, int]:
+        """Speculative-decoding counters, exposed by serve/worker.py as the
+        dedicated lmstudio_spec_*_total metric families."""
+        return {
+            "verifies": self.spec_verifies,
+            "drafted": self.spec_drafted,
+            "accepted": self.spec_accepted,
         }
 
     def counters(self) -> dict[str, int]:
@@ -178,6 +199,9 @@ class BatcherStats:
             "ring_compactions": self.ring_compactions,
             "cancelled": self.cancelled,
             "shed": self.shed,
+            "spec_verifies": self.spec_verifies,
+            "spec_drafted": self.spec_drafted,
+            "spec_accepted": self.spec_accepted,
             "shed_causes": shed_causes,
             "tokens_per_step_avg": round(self.tokens / self.steps, 2) if self.steps else 0.0,
             "admit_queue_delay_p50_ms": round(adm.percentile(0.5), 1),
@@ -211,6 +235,8 @@ class ContinuousBatcher:
         max_queue: int = 0,
         max_queue_age_ms: float = 0.0,
         prefix_cache_blocks: int = 0,
+        spec_decode_k: int = 0,
+        spec_max_active: int = 4,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -272,6 +298,24 @@ class ContinuousBatcher:
         self.prefix_cache: PrefixCache | None = (
             PrefixCache(self.prefill_chunk, prefix_cache_blocks)
             if prefix_cache_blocks > 0
+            else None
+        )
+        # speculative decoding (serve/spec.py): k > 0 turns it on AND flips
+        # the whole cache to POSITIONAL layout (slot = sequence position,
+        # the ring_slot=None path of models.llama.forward). Per-slot
+        # acceptance counts differ, so the shared-ring invariant ("every
+        # row's history ends at one common head") cannot survive a verify;
+        # positional layout has no shared head, and a rejected draft needs
+        # no KV rollback — stale entries above the accepted length are
+        # masked by position and overwritten by that row's next writes.
+        # Tradeoff: positional decode writes via a per-row scatter (the
+        # serialized-row cost the ring path exists to avoid), which is why
+        # spec is worth it at LOW occupancy (the memory-bound regime) and
+        # verify dispatches auto-disable above ``spec_max_active`` live
+        # slots. 0 keeps the ring hot path byte-for-byte unchanged.
+        self.spec_cfg: SpecConfig | None = (
+            SpecConfig(k=spec_decode_k, max_active=max(1, spec_max_active))
+            if spec_decode_k > 0
             else None
         )
         self.stats = BatcherStats()
@@ -528,6 +572,53 @@ class ContinuousBatcher:
             # [B, n] tokens, caches, device-side carries
             return toks.T, K, V, tok, pos + n, steps + n
 
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(10, 11))
+        def decode_pos(params, tok, K, V, pos, seeds, steps, temp, topk, topp,
+                       n, window):
+            """Positional-layout decode burst: spec mode's fallback when no
+            slot has a draft (or occupancy passed spec_max_active). Same
+            contract as ``decode`` minus the ring scalar — each row writes
+            its fresh KV at its own sequence position ``pos + i`` (per-row
+            scatter) and attention masks by ``key_pos <= position``."""
+
+            def body(carry, i):
+                tok, K, V = carry
+                logits, K, V = fwd(
+                    params, tokens=tok[:, None], k_cache=K, v_cache=V,
+                    start_pos=pos + i, attn_window=window,
+                )
+                nxt = sample_rows(logits[:, -1, :], seeds, steps + i, temp, topk, topp)
+                return (nxt, K, V), nxt
+
+            (tok, K, V), toks = jax.lax.scan(
+                body, (tok, K, V), jnp.arange(n, dtype=jnp.int32)
+            )
+            return toks.T, K, V, tok, pos + n, steps + n
+
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(12,))
+        def spec_verify(params, tok, K, V, pos, drafts, dlen, seeds, steps,
+                        temp, topk, topp, window):
+            """One width-(k+1) VERIFY dispatch: forward the device carry
+            token plus k drafted tokens through the positional decode
+            cache-write path in a single program (the weight tree is read
+            once for k+1 token positions — the bandwidth conversion the
+            whole feature exists for), then run the rejection-sampling
+            acceptance rule on device. Only the accepted prefix advances
+            the carries; KV written for rejected positions is stale by
+            construction (see spec.py: masked by position, overwritten by
+            this row's own future writes — no rollback)."""
+            toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B,k+1]
+            logits, K, V = fwd(
+                params, tokens=toks_in, k_cache=K, v_cache=V,
+                start_pos=pos, attn_window=window,
+            )
+            out, n_emit = spec_accept_rows(
+                logits, drafts, dlen, seeds, steps, temp, topk, topp
+            )
+            new_tok = jnp.take_along_axis(out, (n_emit - 1)[:, None], axis=1)[:, 0]
+            width = toks_in.shape[1]
+            return out, n_emit, K, V, new_tok, pos + n_emit, steps + width
+
         self._prefill1 = prefill1
         self._prefill_full = prefill_full
         self._write_prefix_block = write_prefix_block
@@ -538,6 +629,8 @@ class ContinuousBatcher:
         self._select_end = select_end
         self._finish_admit_group = finish_admit_group
         self._decode = decode
+        self._decode_pos = decode_pos
+        self._spec_verify = spec_verify
         self._compact_ring = compact_ring
 
         self._inbox: _queue.Queue[_Request | None] = _queue.Queue()
@@ -780,6 +873,15 @@ class ContinuousBatcher:
     def _run(self) -> None:
         cfg = self.cfg
         B = self.max_slots
+        # speculative decoding: when on, the WHOLE cache runs in positional
+        # layout (see __init__) — ring head bookkeeping stays frozen at the
+        # cold state and every shift/offset below is forced to 0 so admitted
+        # prefixes land at sequence positions [0, n)
+        spec = self.spec_cfg
+        positional = spec is not None
+        # per-slot n-gram index over prompt + generated tokens (owner-thread
+        # state, created at the admit record's readback, dropped with the slot)
+        spec_slots: list[SpecSlot | None] = [None] * B
         # ring head: the shared cache slot the next decode step writes; rows'
         # validity is "my last pos+1 ring slots", see models.llama.forward
         self._ring_next = 0
@@ -833,6 +935,7 @@ class ContinuousBatcher:
             self._slots[i] = None
             host_pos[i] = 0
             host_steps[i] = 0
+            spec_slots[i] = None
             nonlocal dirty
             dirty = True
 
@@ -859,10 +962,52 @@ class ContinuousBatcher:
                         finish_slot(slot)
                         self.stats.record_cancel("decode")
                         continue
+                    st = spec_slots[slot]
                     try:
                         for j in range(n):
                             req.pos += 1
-                            reason = self._deliver(req, int(ids[slot, j]))
+                            t = int(ids[slot, j])
+                            if st is not None:
+                                st.index.append(t)
+                            reason = self._deliver(req, t)
+                            if reason is not None:
+                                finish_slot(slot)  # free BEFORE the end event
+                                req.emit("end", reason)
+                                break
+                    except Exception:  # noqa: BLE001 — dead client
+                        log.exception("delivery failed; dropping slot %d", slot)
+                        finish_slot(slot)
+            elif rec[0] == "spec":
+                _, out_ref, nacc_ref, rows, t_disp = rec
+                ids = np.asarray(out_ref)  # [B, k+1]
+                nacc = np.asarray(nacc_ref)  # [B] emitted counts (a + 1)
+                self.stats.decode_step_ms.record((time.monotonic() - t_disp) * 1e3)
+                for slot, req, dlen in rows:
+                    if self._slots[slot] is not req:
+                        continue  # spec is depth-0, but stay defensive
+                    n_emit = int(nacc[slot])
+                    # host pos catches up to the device carry HERE (spec is
+                    # the one dispatch whose advance is data-dependent);
+                    # host_steps advanced by k+1 at dispatch
+                    host_pos[slot] += n_emit
+                    if dlen > 0:
+                        self.stats.spec_drafted += dlen
+                        self.stats.spec_accepted += n_emit - 1
+                        self.stats.spec_accept_rate.record(
+                            max((n_emit - 1) / dlen, 0.01)
+                        )
+                    if req.cancelled:
+                        finish_slot(slot)
+                        self.stats.record_cancel("decode")
+                        continue
+                    st = spec_slots[slot]
+                    try:
+                        for j in range(n_emit):
+                            req.pos += 1
+                            t = int(ids[slot, j])
+                            if st is not None:
+                                st.index.append(t)
+                            reason = self._deliver(req, t)
                             if reason is not None:
                                 finish_slot(slot)  # free BEFORE the end event
                                 req.emit("end", reason)
@@ -881,10 +1026,17 @@ class ContinuousBatcher:
                         self.stats.record_cancel("admit")
                         continue
                     try:
-                        reason = self._deliver(req, int(ids[row]))
+                        first = int(ids[row])
+                        reason = self._deliver(req, first)
                         if reason is not None:
                             finish_slot(slot)  # free BEFORE the end event
                             req.emit("end", reason)
+                        elif spec is not None:
+                            # history = prompt + the first sampled token
+                            # (still riding the device carry, unwritten)
+                            spec_slots[slot] = make_slot(
+                                req.prompt_ids, first, spec
+                            )
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
                         finish_slot(slot)
@@ -938,27 +1090,34 @@ class ContinuousBatcher:
             self.stats.ring_compactions += 1
             obs_emit("ring_compaction", shift=shift, head=head, active=len(act))
 
+        def refresh_rows() -> None:
+            """Re-upload the per-slot sampling tensors and pos/step/seed
+            carries after a membership change (``dirty``)."""
+            nonlocal temp, topk, topp, pos_dev, steps_dev, seeds_dev, dirty
+            if not dirty:
+                return
+            live = [r if isinstance(r, _Request) else None for r in self._slots]
+            temp = jnp.asarray(
+                [r.sp.temperature if r else 0.0 for r in live], jnp.float32
+            )
+            topk = jnp.asarray([r.sp.top_k if r else 0 for r in live], jnp.int32)
+            topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in live], jnp.float32)
+            pos_dev = jnp.asarray(host_pos, jnp.int32)
+            steps_dev = jnp.asarray(host_steps, jnp.int32)
+            seeds_dev = jnp.asarray(host_seed, jnp.int32)
+            dirty = False
+
         def decode_once() -> None:
             """Dispatch one decode burst (decode_burst steps) for every
             active slot. Does NOT read the tokens back — the record goes on
             the in-flight queue and pump() delivers it while the next burst
             computes."""
-            nonlocal K, V, tok_dev, temp, topk, topp, dirty
+            nonlocal K, V, tok_dev, dirty
             nonlocal pos_dev, steps_dev, seeds_dev
             act = active()
             if not act:
                 return
-            if dirty:
-                live = [r if isinstance(r, _Request) else None for r in self._slots]
-                temp = jnp.asarray(
-                    [r.sp.temperature if r else 0.0 for r in live], jnp.float32
-                )
-                topk = jnp.asarray([r.sp.top_k if r else 0 for r in live], jnp.int32)
-                topp = jnp.asarray([r.sp.top_p if r else 1.0 for r in live], jnp.float32)
-                pos_dev = jnp.asarray(host_pos, jnp.int32)
-                steps_dev = jnp.asarray(host_steps, jnp.int32)
-                seeds_dev = jnp.asarray(host_seed, jnp.int32)
-                dirty = False
+            refresh_rows()
             # cap the burst so no active row can run past the cache capacity.
             # n is a static jit arg: snap to single steps near capacity
             # instead of counting down through n-1 fresh compiles.
@@ -971,21 +1130,32 @@ class ContinuousBatcher:
             # may be <= 0 here and n=1 covers it.
             headroom = self.max_seq - 1 - max(host_pos[i] for i in act)
             n = self.decode_burst if headroom >= self.decode_burst else 1
-            # until the ring wraps, every live slot index is < ring_next:
-            # attention can read just a bucket covering the head (static
-            # windows come from self.buckets, so compiles stay bounded)
-            window = None
-            if not self._ring_wrapped:
-                w = self._bucket(self._ring_next + n)
-                if w < self.max_seq:
-                    window = w
-            toks, K, V, tok_dev, pos_dev, steps_dev = self._decode(
-                self.params, tok_dev, K, V, pos_dev, jnp.int32(self._ring_next),
-                seeds_dev, steps_dev, temp, topk, topp, n, window,
-            )
-            if self._ring_next + n >= self.max_seq:
-                self._ring_wrapped = True
-            self._ring_next = (self._ring_next + n) % self.max_seq
+            if positional:
+                # writes land at each row's own position: the window only
+                # needs to cover the highest live position after the burst
+                # (pow2 ladder, same bounded-compile argument as prefill)
+                w = self._win_bucket(max(host_pos[i] for i in act) + n + 1)
+                window = w if w < self.max_seq else None
+                toks, K, V, tok_dev, pos_dev, steps_dev = self._decode_pos(
+                    self.params, tok_dev, K, V, pos_dev,
+                    seeds_dev, steps_dev, temp, topk, topp, n, window,
+                )
+            else:
+                # until the ring wraps, every live slot index is < ring_next:
+                # attention can read just a bucket covering the head (static
+                # windows come from self.buckets, so compiles stay bounded)
+                window = None
+                if not self._ring_wrapped:
+                    w = self._bucket(self._ring_next + n)
+                    if w < self.max_seq:
+                        window = w
+                toks, K, V, tok_dev, pos_dev, steps_dev = self._decode(
+                    self.params, tok_dev, K, V, pos_dev, jnp.int32(self._ring_next),
+                    seeds_dev, steps_dev, temp, topk, topp, n, window,
+                )
+                if self._ring_next + n >= self.max_seq:
+                    self._ring_wrapped = True
+                self._ring_next = (self._ring_next + n) % self.max_seq
             self.stats.steps += n
             self.stats.tokens_per_step.record(float(len(act)))
             for i in act:
@@ -994,6 +1164,60 @@ class ContinuousBatcher:
             inflight.append(
                 ("decode", toks, n, [(i, self._slots[i]) for i in act], time.monotonic())
             )
+
+        def spec_once() -> bool:
+            """Dispatch ONE verify forward when at least one live slot has a
+            prompt-lookup draft. Returns False (caller runs a plain burst)
+            when nothing drafted, a row is too close to the cache end for a
+            width-(k+1) write, or there are no active slots. The caller must
+            have DRAINED the pipeline first (proposals read each slot's full
+            token history, which is only current after every readback) and
+            must drain again right after (host pos catches up at readback)."""
+            nonlocal K, V, tok_dev, dirty, pos_dev, steps_dev, seeds_dev
+            act = active()
+            if not act:
+                return False
+            kspec = spec.k
+            if max(host_pos[i] for i in act) + kspec + 1 >= self.max_seq:
+                # the per-row cache write would clamp past the end; the
+                # plain burst path's n=1 capacity snap handles the tail
+                return False
+            drafts = np.zeros((B, kspec), np.int32)
+            dlens = [0] * B
+            total = 0
+            for i in act:
+                st = spec_slots[i]
+                if st is None:
+                    continue  # admit readback pending (caller drains first)
+                d = st.index.propose(kspec)
+                if d:
+                    drafts[i, : len(d)] = d
+                    dlens[i] = len(d)
+                    total += len(d)
+            if total == 0:
+                return False  # nothing to verify: a plain burst is cheaper
+            refresh_rows()
+            w = self._win_bucket(max(host_pos[i] for i in act) + kspec + 1)
+            window = w if w < self.max_seq else None
+            out, nacc, K, V, tok_dev, pos_dev, steps_dev = self._spec_verify(
+                self.params, tok_dev, K, V, pos_dev,
+                jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
+                seeds_dev, steps_dev, temp, topk, topp, window,
+            )
+            self.stats.steps += 1
+            self.stats.spec_verifies += 1
+            self.stats.tokens_per_step.record(float(len(act)))
+            for i in act:
+                # rng streams advance by the verify width for every row
+                # (deterministic, matches the device carry); host_pos
+                # advances at READBACK — acceptance is data-dependent
+                host_steps[i] += kspec + 1
+            inflight.append((
+                "spec", out, nacc,
+                [(i, self._slots[i], dlens[i]) for i in act],
+                time.monotonic(),
+            ))
+            return True
 
         pc = self.prefix_cache
 
@@ -1055,7 +1279,9 @@ class ContinuousBatcher:
                 # short prompt: the whole admit is one fused dispatch
                 bucket = self._bucket(n)
                 tokens = jnp.asarray([req.prompt_ids + [0] * (bucket - n)], jnp.int32)
-                shift = jnp.int32((self._ring_next - n) % self.max_seq)
+                shift = jnp.int32(
+                    0 if positional else (self._ring_next - n) % self.max_seq
+                )
                 first, K, V, tok_dev = self._admit_fused(
                     self.params, K, V, tok_dev, tokens, jnp.int32(n),
                     jnp.int32(slot), shift, *samp,
@@ -1168,7 +1394,9 @@ class ContinuousBatcher:
                 # interleaved decode_once() calls advanced the ring head,
                 # and the prefix has to end at the CURRENT head for the
                 # ring-validity mask to see it
-                shift = jnp.int32((self._ring_next - n) % self.max_seq)
+                shift = jnp.int32(
+                    0 if positional else (self._ring_next - n) % self.max_seq
+                )
                 first, K, V, tok_dev = self._finish_admit(
                     self.params, K, V, tok_dev, k1, v1, logits,
                     jnp.int32(slot), shift, *samp,
@@ -1188,6 +1416,8 @@ class ContinuousBatcher:
         def note_admit(n: int) -> None:
             """Shared cold-ring / wrap bookkeeping for an admit of length n
             (the ring-validity invariant lives in exactly one place)."""
+            if positional:
+                return  # no shared head: prefixes always land at [0, n)
             if not any(r is not None for r in self._slots):
                 self._ring_next = n  # cold ring: the prefix fits below
                 self._ring_wrapped = False
@@ -1206,8 +1436,9 @@ class ContinuousBatcher:
             max_n = max(ns)
             note_admit(max_n)
             # every [bucket]-length block [ring_next - n_i, ring_next - n_i
-            # + bucket) must lie inside [0, max_seq)
-            if (
+            # + bucket) must lie inside [0, max_seq). Positional mode has no
+            # head: blocks land at [0, bucket) and can never wrap.
+            if not positional and (
                 self._ring_next < max_n
                 or self._ring_next - min(ns) + bucket > self.max_seq
             ):
@@ -1234,7 +1465,8 @@ class ContinuousBatcher:
                     jnp.asarray([ns[i] for i in idx], jnp.int32),
                     jnp.asarray([slots[i] for i in idx], jnp.int32),
                     jnp.asarray(
-                        [self._ring_next - ns[i] for i in idx], jnp.int32
+                        [0 if positional else self._ring_next - ns[i] for i in idx],
+                        jnp.int32,
                     ),
                     jnp.asarray([seeds[i] for i in idx], jnp.int32),
                     jnp.asarray([reqs[i].sp.temperature for i in idx], jnp.float32),
@@ -1352,7 +1584,10 @@ class ContinuousBatcher:
                         harvest_prefix(reqs[j].prompt_ids, km, vm, j, cl)
                     glogits = None
                 # shifts AFTER the loop: interleaved decodes moved the head
-                shifts = [(self._ring_next - ns[i]) % self.max_seq for i in idx]
+                shifts = [
+                    0 if positional else (self._ring_next - ns[i]) % self.max_seq
+                    for i in idx
+                ]
                 firsts, K, V, tok_dev = self._finish_admit_group(
                     self.params, K, V, tok_dev, km, vm, final,
                     jnp.asarray([slots[i] for i in idx], jnp.int32),
@@ -1400,6 +1635,7 @@ class ContinuousBatcher:
                     self._slots[i] = None
                     host_pos[i] = 0
                     host_steps[i] = 0
+                spec_slots[i] = None
             self._ring_next = 0
             self._ring_wrapped = False
             dirty = True
@@ -1658,8 +1894,23 @@ class ContinuousBatcher:
                 ):
                     pump(0)
                 maybe_compact()
-                decode_once()
-                pump()
+                if spec is not None and 0 < len(active()) <= spec.max_active:
+                    # speculative regime (low occupancy = memory-bound):
+                    # drain so proposals see full history and admit records
+                    # have installed their n-gram indices, verify, drain
+                    # again (host pos only catches up at readback). The
+                    # depth-2 pipeline is deliberately given up here — one
+                    # verify emits up to k+1 tokens per slot, so the
+                    # readback round trip amortizes across the whole burst.
+                    pump(0)
+                    if spec_once():
+                        pump(0)
+                    else:
+                        decode_once()
+                        pump()
+                else:
+                    decode_once()
+                    pump()
             except Exception:  # noqa: BLE001 — K/V were donated; must reset
                 reset_after_failed_dispatch()
 

@@ -63,6 +63,32 @@ def _prefix_cache_blocks_env(default: int = 64) -> int:
     return default
 
 
+def _spec_decode_env(default_k: int = 6) -> tuple[int, int]:
+    """(spec_decode_k, spec_max_active) from the env (serve/spec.py).
+    ``SPEC_DECODE=0`` (or false/off) is the hard off-switch; otherwise
+    ``SPEC_DECODE_K`` sizes the draft (0 also disables) and
+    ``SPEC_DECODE_MAX_ACTIVE`` bounds the occupancy at which verify
+    dispatches still run."""
+    k = default_k
+    if os.environ.get("SPEC_DECODE", "").strip().lower() in ("0", "false", "off"):
+        k = 0
+    else:
+        env = os.environ.get("SPEC_DECODE_K", "").strip()
+        if env:
+            try:
+                k = max(0, int(env))
+            except ValueError:
+                log.warning("ignoring non-integer SPEC_DECODE_K=%r", env)
+    max_active = 4
+    env = os.environ.get("SPEC_DECODE_MAX_ACTIVE", "").strip()
+    if env:
+        try:
+            max_active = max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer SPEC_DECODE_MAX_ACTIVE=%r", env)
+    return k, max_active
+
+
 class JaxChatEngine(ChatEngine):
     """One loaded model: tokenizer + continuous batcher. Concurrent chats
     join the shared fixed-width decode step; the batcher's dedicated owner
@@ -252,6 +278,8 @@ class LocalRegistry(Registry):
         admit_queue_limit: int = 0,
         admit_max_age_ms: float = 0.0,
         prefix_cache_blocks: int | None = None,
+        spec_decode_k: int | None = None,
+        spec_max_active: int | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -269,6 +297,13 @@ class LocalRegistry(Registry):
         self.admit_max_age_ms = admit_max_age_ms
         # per-engine prefix KV cache budget in chunk blocks (0 = off);
         # None = read PREFIX_CACHE / PREFIX_CACHE_BLOCKS from the env
+        # speculative decoding knobs handed to every batcher (k 0 = off);
+        # None = read SPEC_DECODE / SPEC_DECODE_K / SPEC_DECODE_MAX_ACTIVE
+        env_k, env_ma = _spec_decode_env()
+        self.spec_decode_k = spec_decode_k if spec_decode_k is not None else env_k
+        self.spec_max_active = (
+            spec_max_active if spec_max_active is not None else env_ma
+        )
         self.prefix_cache_blocks = (
             prefix_cache_blocks
             if prefix_cache_blocks is not None
@@ -573,6 +608,8 @@ class LocalRegistry(Registry):
             mesh=self.mesh, max_queue=self.admit_queue_limit,
             max_queue_age_ms=self.admit_max_age_ms,
             prefix_cache_blocks=self.prefix_cache_blocks,
+            spec_decode_k=self.spec_decode_k,
+            spec_max_active=self.spec_max_active,
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time

@@ -462,6 +462,12 @@ class Worker:
             for cause, v in stats.shed_cause_counts().items():
                 r.counter("lmstudio_batcher_shed_by_cause_total", v,
                           labels={**labels, "cause": cause})
+            if hasattr(stats, "spec_counters"):
+                # speculative decoding: lmstudio_spec_{verifies,drafted,
+                # accepted}_total; the lmstudio_spec_accept_rate histogram
+                # rides the generic histograms() loop below
+                for name, v in stats.spec_counters().items():
+                    r.counter(f"lmstudio_spec_{name}_total", v, labels=labels)
             for name, h in stats.histograms().items():
                 r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
             pcache = getattr(eng.batcher, "prefix_cache", None)

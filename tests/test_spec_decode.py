@@ -1,0 +1,305 @@
+"""Speculative decoding tests: prompt-lookup proposals, the rejection-
+sampling acceptance rule (distribution-preserving), and end-to-end
+equivalence of the speculative batcher against non-speculative decoding
+(greedy must be bit-identical; temperature>0 must be token-identical to
+the Generator's reference speculative loop under the same seed/k)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.engine.generator import Generator, SamplingParams
+from nats_llm_studio_tpu.engine.sampling import (
+    _log_weights,
+    sample_rows,
+    spec_accept_rows,
+)
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+from nats_llm_studio_tpu.serve.spec import NGramIndex
+
+from conftest import async_test
+
+# a prompt whose greedy continuation cycles (high n-gram hit rate on tiny
+# random weights) and one with no internal repetition (zero-hit)
+REP = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
+FLAT = [1, 9, 3, 17, 2, 11]
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def gen(model):
+    cfg, params = model
+    return Generator(params, cfg, max_seq_len=128, buckets=[8, 16, 32, 64, 128])
+
+
+# ---------------------------------------------------------------------------
+# prompt-lookup proposal index
+# ---------------------------------------------------------------------------
+
+
+def test_ngram_index_proposes_continuation():
+    idx = NGramIndex([1, 2, 3, 9, 1, 2, 3], max_ngram=3, min_ngram=1)
+    # tail trigram (1,2,3) last occurred ending at index 2: the proposal is
+    # what followed it
+    assert idx.propose(2) == [9, 1]
+    assert idx.propose(4) == [9, 1, 2, 3]
+
+
+def test_ngram_index_zero_hit():
+    idx = NGramIndex([1, 2, 3, 4, 5], max_ngram=3, min_ngram=1)
+    assert idx.propose(4) == []
+
+
+def test_ngram_index_append_updates_tail():
+    idx = NGramIndex([1, 2, 3], max_ngram=3, min_ngram=1)
+    assert idx.propose(2) == []
+    idx.append(1)  # history [1,2,3,1]: tail unigram (1,) seen at index 0
+    assert idx.propose(2) == [2, 3]
+    idx.extend([2, 3])  # [1,2,3,1,2,3]: trigram hit beats the unigram
+    assert idx.propose(3) == [1, 2, 3]
+
+
+def test_ngram_index_prefers_longest_match():
+    # unigram tail 7 occurs after 9; bigram (5, 7) occurs after 8 — the
+    # longer context must win
+    idx = NGramIndex([7, 9, 5, 7, 8, 5, 7], max_ngram=3, min_ngram=1)
+    assert idx.propose(1) == [8]
+
+
+# ---------------------------------------------------------------------------
+# rejection-sampling acceptance preserves the sampling distribution
+# ---------------------------------------------------------------------------
+
+
+def _empirical(tokens: np.ndarray, v: int) -> np.ndarray:
+    return np.bincount(tokens, minlength=v) / float(len(tokens))
+
+
+@pytest.mark.parametrize(
+    "top_k,top_p",
+    [(0, 1.0), (5, 1.0), (0, 0.8)],
+    ids=["unrestricted", "topk5", "topp08"],
+)
+def test_spec_accept_matches_plain_distribution(top_k, top_p):
+    """Seeded statistical check: the first token emitted by the rejection
+    sampler (accept-or-resample against a point-mass draft) has the same
+    distribution the plain sampler draws from."""
+    v, n = 16, 4000
+    rng = np.random.default_rng(7)
+    row = jnp.asarray(rng.normal(size=(v,)) * 2.0, jnp.float32)
+    logits = jnp.broadcast_to(row, (n, v))
+    seeds = jnp.arange(n, dtype=jnp.int32)
+    steps = jnp.zeros((n,), jnp.int32)
+    temp = jnp.full((n,), 1.0, jnp.float32)
+    tk = jnp.full((n,), top_k, jnp.int32)
+    tp = jnp.full((n,), top_p, jnp.float32)
+
+    # analytic target: softmax of the (possibly truncated) log-weights
+    p_ref = np.asarray(
+        jax.nn.softmax(_log_weights(row[None, :], temp[:1], tk[:1], tp[:1]))
+    )[0]
+
+    # draft a mid-probability token so both accept and reject paths run
+    d = int(np.argsort(p_ref)[-2])
+    verify_logits = jnp.broadcast_to(row, (n, 2, v))
+    drafts = jnp.full((n, 1), d, jnp.int32)
+    dlen = jnp.ones((n,), jnp.int32)
+    out, n_emit = spec_accept_rows(
+        verify_logits, drafts, dlen, seeds, steps, temp, tk, tp
+    )
+    out, n_emit = np.asarray(out), np.asarray(n_emit)
+    assert set(np.unique(n_emit)) <= {1, 2}
+    # both paths must actually be exercised
+    assert 0.05 < float((n_emit == 2).mean()) < 0.95 or p_ref[d] > 0.9
+
+    spec_emp = _empirical(out[:, 0], v)
+    plain = np.asarray(sample_rows(logits, seeds, steps, temp, tk, tp))
+    plain_emp = _empirical(plain, v)
+
+    tv_spec = 0.5 * np.abs(spec_emp - p_ref).sum()
+    tv_plain = 0.5 * np.abs(plain_emp - p_ref).sum()
+    assert tv_plain < 0.03  # sanity: the plain sampler matches its target
+    assert tv_spec < 0.03, f"spec TV {tv_spec:.4f} vs plain TV {tv_plain:.4f}"
+
+    # truncation must be respected exactly (zero-probability tokens never
+    # emitted), not just approximately
+    banned = np.flatnonzero(p_ref == 0.0)
+    assert not np.isin(out[:, 0], banned).any()
+
+
+def test_spec_bonus_token_distribution():
+    """When every draft is accepted, the bonus token is a PLAIN sample from
+    the last verify position — check it against the analytic distribution."""
+    v, n = 16, 4000
+    rng = np.random.default_rng(11)
+    row0 = np.asarray(rng.normal(size=(v,)), np.float32)
+    row1 = np.asarray(rng.normal(size=(v,)) * 2.0, np.float32)
+    d = int(row0.argmax())
+    row0[d] += 50.0  # p0(d) ~ 1: the draft is (almost) always accepted
+    verify_logits = jnp.broadcast_to(
+        jnp.asarray(np.stack([row0, row1])), (n, 2, v)
+    )
+    seeds = jnp.arange(n, dtype=jnp.int32)
+    steps = jnp.zeros((n,), jnp.int32)
+    temp = jnp.full((n,), 1.0, jnp.float32)
+    tk = jnp.zeros((n,), jnp.int32)
+    tp = jnp.ones((n,), jnp.float32)
+    out, n_emit = spec_accept_rows(
+        verify_logits,
+        jnp.full((n, 1), d, jnp.int32),
+        jnp.ones((n,), jnp.int32),
+        seeds, steps, temp, tk, tp,
+    )
+    out, n_emit = np.asarray(out), np.asarray(n_emit)
+    full = n_emit == 2
+    assert full.mean() > 0.99
+    p1 = np.asarray(jax.nn.softmax(jnp.asarray(row1)))
+    emp = _empirical(out[full, 1], v)
+    assert 0.5 * np.abs(emp - p1).sum() < 0.03
+
+
+def test_spec_accept_greedy_is_argmax_prefix():
+    """Greedy rows accept exactly the longest draft prefix equal to the
+    model argmax, then emit the argmax at the first mismatch."""
+    v = 8
+    rows = np.zeros((1, 4, v), np.float32)
+    argmaxes = [3, 5, 2, 6]
+    for t, a in enumerate(argmaxes):
+        rows[0, t, a] = 5.0
+    out, n_emit = spec_accept_rows(
+        jnp.asarray(rows),
+        jnp.asarray([[3, 5, 7]], jnp.int32),  # third draft wrong (7 != 2)
+        jnp.asarray([3], jnp.int32),
+        jnp.asarray([0], jnp.int32),
+        jnp.asarray([0], jnp.int32),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([0], jnp.int32),
+        jnp.asarray([1.0], jnp.float32),
+    )
+    assert int(n_emit[0]) == 3
+    assert np.asarray(out)[0, :3].tolist() == [3, 5, 2]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end equivalence through the batcher
+# ---------------------------------------------------------------------------
+
+
+async def _batch_run(cfg, params, prompts, sp, k, burst=1):
+    b = ContinuousBatcher(
+        params, cfg, max_slots=4, max_seq_len=128, buckets=[8, 128],
+        spec_decode_k=k, decode_burst=burst,
+    )
+    try:
+        async def one(p):
+            return [t async for t in b.submit(p, sp)]
+
+        got = await asyncio.gather(*[one(p) for p in prompts])
+        return list(got), b.stats.snapshot()
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_greedy_spec_bit_identical_high_hit(model, gen):
+    """Repetition-heavy prompt: verifies fire, drafts get accepted, and the
+    output is still bit-identical to the non-speculative Generator."""
+    cfg, params = model
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+    want = [t for t, _ in gen.generate(REP, sp)]
+    got, stats = await _batch_run(cfg, params, [REP], sp, k=4)
+    assert got[0] == want
+    assert stats["spec_verifies"] > 0
+    assert stats["spec_accepted"] > 0
+
+
+@async_test
+async def test_greedy_spec_bit_identical_zero_hit(model, gen):
+    """No n-gram hits: the batcher must degrade to plain decoding with the
+    same greedy output (acceptance handles whatever drafting produces)."""
+    cfg, params = model
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+    want = [t for t, _ in gen.generate(FLAT, sp)]
+    got, stats = await _batch_run(cfg, params, [FLAT], sp, k=4)
+    assert got[0] == want
+
+
+@async_test
+async def test_greedy_spec_concurrent_matches_single_stream(model, gen):
+    cfg, params = model
+    prompts = [REP, FLAT, [2, 3, 2, 3, 2, 3, 2], [8]]
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    want = [[t for t, _ in gen.generate(p, sp)] for p in prompts]
+    got, stats = await _batch_run(cfg, params, prompts, sp, k=4)
+    assert got == want
+    assert stats["spec_drafted"] >= stats["spec_accepted"]
+
+
+@async_test
+async def test_spec_disabled_above_max_active(model, gen):
+    """Occupancy past spec_max_active pauses verify dispatches but plain
+    positional decoding must still produce correct greedy output."""
+    cfg, params = model
+    prompts = [REP, FLAT, [2, 3, 2, 3, 2, 3, 2], [8]]
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    want = [[t for t, _ in gen.generate(p, sp)] for p in prompts]
+    b = ContinuousBatcher(
+        params, cfg, max_slots=4, max_seq_len=128, buckets=[8, 128],
+        spec_decode_k=4, spec_max_active=1, decode_burst=1,
+    )
+    try:
+        async def one(p):
+            return [t async for t in b.submit(p, sp)]
+
+        got = await asyncio.gather(*[one(p) for p in prompts])
+        assert list(got) == want
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_temperature_batcher_matches_reference_loop(model, gen):
+    """temperature > 0, single request, decode_burst=1: the batcher's
+    speculative path is token-identical to the Generator's reference
+    speculative loop (same seed, same k, same proposal points)."""
+    cfg, params = model
+    for prompt in (REP, FLAT):
+        sp = SamplingParams(
+            temperature=0.9, max_tokens=30, seed=1234, top_k=40, top_p=0.95
+        )
+        ref = [t for t, _ in gen.generate_speculative(prompt, sp, spec_k=4)]
+        got, _ = await _batch_run(cfg, params, [prompt], sp, k=4)
+        assert got[0] == ref
+
+
+def test_greedy_reference_loop_matches_generate(model, gen):
+    """The Generator's speculative loop is itself bit-identical to plain
+    generate() at temperature 0 (acceptance == argmax prefix)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+    for prompt in (REP, FLAT):
+        want = [t for t, _ in gen.generate(prompt, sp)]
+        got = [t for t, _ in gen.generate_speculative(prompt, sp, spec_k=4)]
+        assert got == want
+
+
+def test_warmup_covers_decode(model):
+    """warmup() must block on BOTH the prefill and decode outputs of every
+    bucket (the old code only waited on the last bucket's prefill logits),
+    and must leave the generator fully usable."""
+    cfg, params = model
+    g = Generator(params, cfg, max_seq_len=64, buckets=[8, 16, 32, 64])
+    g.warmup()
+    sp = SamplingParams(temperature=0.0, max_tokens=4)
+    out = [t for t, _ in g.generate([1, 2, 3], sp)]
+    assert len(out) == 4
