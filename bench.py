@@ -1353,6 +1353,133 @@ def spec_decode_bench(cfg, params, model_id: str, *, seq: int | None = None,
 # ---------------------------------------------------------------------------
 
 
+def chaos_bench() -> dict:
+    """Fault-injected serving (transport/faults.py): a seeded FaultPlan
+    severs the client's broker connection mid-run AND crashes the engine
+    pump loop once. Every request must still complete — auto-reconnect +
+    request retry on the client, supervisor engine restart on the worker.
+    Reports recovery behavior (reconnects, restarts, restart latency, total
+    wall time), not throughput; runs a tiny model so the phase measures the
+    resilience machinery, not XLA."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.gguf.constants import TokenType
+    from nats_llm_studio_tpu.gguf.tokenizer import _byte_to_unicode
+    from nats_llm_studio_tpu.models.export import export_params_to_gguf
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.store.manager import ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+    from nats_llm_studio_tpu.transport import faults
+
+    mid = "bench/chaos-tiny"
+    n_reqs = int(os.environ.get("BENCH_CHAOS_REQS", "8"))
+    tcfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    tparams = init_params(tcfg, jax.random.PRNGKey(5))
+    b2u = _byte_to_unicode()
+    tokens = [b2u[b] for b in range(256)]
+    while len(tokens) < tcfg.vocab_size - 1:
+        tokens.append(f"<filler_{len(tokens)}>")
+    tokens.append("<|eot|>")
+    tok_md = {
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.token_type": (
+            [int(TokenType.NORMAL)] * (tcfg.vocab_size - 1)
+            + [int(TokenType.CONTROL)]
+        ),
+        "tokenizer.ggml.merges": [],
+        "tokenizer.ggml.eos_token_id": tcfg.vocab_size - 1,
+        "tokenizer.ggml.add_bos_token": False,
+    }
+
+    async def run(models_dir: Path) -> dict:
+        d = models_dir / mid
+        d.mkdir(parents=True)
+        export_params_to_gguf(d / "m.gguf", tparams, tcfg, name=mid,
+                              tokenizer_md=tok_md)
+        broker = await EmbeddedBroker().start()
+        registry = LocalRegistry(
+            ModelStore(models_dir), dtype="float32", max_batch_slots=2,
+            max_seq_len=64, restart_backoff_s=0.05, restart_backoff_max_s=0.2,
+            max_restarts=10, restart_window_s=60.0,
+        )
+        worker = Worker(
+            WorkerConfig(nats_url=broker.url, supervise_interval_s=0.1,
+                         engine_heartbeat_timeout_s=0.0),
+            registry,
+        )
+        await worker.start()
+        nc = await connect(broker.url, reconnect_wait_s=0.02,
+                           reconnect_max_wait_s=0.2)
+        body = json.dumps({
+            "model": mid,
+            "messages": [{"role": "user", "content": "chaos probe"}],
+            "max_tokens": 8, "temperature": 0.0, "stream": False,
+        }).encode()
+        # warm the engine before installing the plan so fault steps land in
+        # the measured serving loop, not the initial load
+        r = json.loads(
+            (await nc.request("lmstudio.chat_model", body, timeout=60)).payload
+        )
+        assert r.get("ok"), r
+        plan = faults.install(
+            faults.FaultPlan(seed=int(os.environ.get("BENCH_CHAOS_SEED", "7")))
+            .sever(faults.BROKER_PUBLISH, 2, subject="lmstudio.chat_model")
+            .raise_at(faults.PUMP, 8, message="bench chaos pump fault")
+        )
+        retry = RetryPolicy(max_attempts=12, backoff_s=0.2, max_backoff_s=1.0,
+                            retry_on_timeout=True)
+        t0 = time.perf_counter()
+        completed = 0
+        try:
+            for _ in range(n_reqs):
+                r = json.loads(
+                    (await nc.request("lmstudio.chat_model", body, timeout=30,
+                                      retry=retry)).payload
+                )
+                if r.get("ok"):
+                    completed += 1
+            wall_s = time.perf_counter() - t0
+        finally:
+            faults.clear()
+        prom = (
+            await nc.request("lmstudio.metrics.prom", b"", timeout=10)
+        ).payload.decode()
+        restart_ms = {
+            line.split()[0].rsplit("_", 1)[-1]: float(line.split()[-1])
+            for line in prom.splitlines()
+            if line.startswith("lmstudio_engine_restart_ms_")
+        }
+        out = {
+            "requests": n_reqs,
+            "completed": completed,
+            "faults_fired": plan.fired(),
+            "all_faults_fired": plan.done(),
+            "client_reconnects": nc.reconnects,
+            "last_reconnect_s": round(nc.last_reconnect_s, 4),
+            "engine_restarts": registry.engine_restarts_total,
+            "inflight_failed_retryable": registry.inflight_failed_retryable
+            + sum(
+                eng.batcher.stats.inflight_failed_retryable
+                for eng in registry.loaded_engines().values()
+                if getattr(eng, "batcher", None) is not None
+            ),
+            "restart_latency_ms": restart_ms,
+            "wall_s": round(wall_s, 3),
+        }
+        await nc.close()
+        await worker.drain()
+        await broker.stop()
+        return out
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(run(Path(td) / "models"))
+
+
 def _print_final(obj: dict) -> None:
     """Emit the results object as ONE compact JSON line, guaranteed LAST on
     stdout: flush both streams first so buffered warmup chatter cannot land
@@ -1384,6 +1511,11 @@ def main() -> None:
                 )
             except Exception as e:  # noqa: BLE001 — report, don't die
                 tiny_detail["spec_decode_error"] = f"{type(e).__name__}: {e}"
+        if os.environ.get("BENCH_CHAOS", "1") != "0":
+            try:  # fault-injected serving: recovery must hold in CI smoke too
+                tiny_detail["chaos"] = chaos_bench()
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                tiny_detail["chaos_error"] = f"{type(e).__name__}: {e}"
         _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
@@ -1505,6 +1637,14 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001 — report, don't die
             detail["spec_decode_error"] = f"{type(e).__name__}: {e}"
+        gc.collect()
+
+    # -- chaos: fault-injected serving recovery (own tiny model) -------------
+    if os.environ.get("BENCH_CHAOS", "1") != "0":
+        try:
+            detail["chaos"] = chaos_bench()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            detail["chaos_error"] = f"{type(e).__name__}: {e}"
         gc.collect()
 
     del params

@@ -89,6 +89,51 @@ class WorkerConfig:
     spec_max_active: int = field(
         default_factory=lambda: int(_env("SPEC_DECODE_MAX_ACTIVE", "4"))
     )
+    # -- transport resilience (transport/client.py) --------------------------
+    # reconnect attempts after a lost connection (exp backoff + jitter,
+    # base→cap below); 0 disables auto-reconnect (connection loss closes the
+    # client, pre-resilience behavior)
+    max_reconnects: int = field(
+        default_factory=lambda: int(_env("NATS_MAX_RECONNECTS", "60"))
+    )
+    reconnect_wait_s: float = field(
+        default_factory=lambda: float(_env("NATS_RECONNECT_WAIT_S", "0.05"))
+    )
+    reconnect_max_wait_s: float = field(
+        default_factory=lambda: float(_env("NATS_RECONNECT_MAX_WAIT_S", "2.0"))
+    )
+    # client-originated PING keepalive: a connection that misses two
+    # consecutive PONGs is declared stale and dropped into the reconnect
+    # path. 0 disables the keepalive task.
+    ping_interval_s: float = field(
+        default_factory=lambda: float(_env("NATS_PING_INTERVAL_S", "30"))
+    )
+    # -- engine supervision (serve/worker.py + serve/registry.py) ------------
+    # watchdog poll period over loaded batchers; 0 disables supervision
+    supervise_interval_s: float = field(
+        default_factory=lambda: float(_env("SUPERVISE_INTERVAL_S", "2"))
+    )
+    # a NON-idle batcher whose owner loop hasn't stamped its heartbeat for
+    # this long is declared hung and restarted; generous default because a
+    # cold XLA compile of a big prefill program legitimately stalls the
+    # loop for minutes. 0 disables the hang check (crash detection stays).
+    engine_heartbeat_timeout_s: float = field(
+        default_factory=lambda: float(_env("ENGINE_HEARTBEAT_TIMEOUT_S", "120"))
+    )
+    engine_restart_backoff_s: float = field(
+        default_factory=lambda: float(_env("ENGINE_RESTART_BACKOFF_S", "0.5"))
+    )
+    engine_restart_backoff_max_s: float = field(
+        default_factory=lambda: float(_env("ENGINE_RESTART_BACKOFF_MAX_S", "30"))
+    )
+    # more than this many crashes inside the window poisons the model:
+    # get_engine refuses (retryable envelope) until a delete/pull resets it
+    engine_max_restarts: int = field(
+        default_factory=lambda: int(_env("ENGINE_MAX_RESTARTS", "3"))
+    )
+    engine_restart_window_s: float = field(
+        default_factory=lambda: float(_env("ENGINE_RESTART_WINDOW_S", "120"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

@@ -52,9 +52,15 @@ async def _run_serve(args: argparse.Namespace) -> None:
     from .serve.registry import LocalRegistry
     from .store import JetStreamStoreModule, ModelStore
     from .transport import EmbeddedBroker, connect
+    from .transport import faults
     from .transport.jetstream import ObjectStore
 
     cfg = WorkerConfig()
+    # deterministic chaos harness (transport/faults.py): only active when
+    # CHAOS_SPEC is set — zero-cost otherwise
+    plan = faults.plan_from_env()
+    if plan is not None:
+        faults.install(plan)
     broker = None
     if args.embedded_broker:
         broker = await EmbeddedBroker(port=args.port).start()
@@ -80,6 +86,10 @@ async def _run_serve(args: argparse.Namespace) -> None:
         admit_queue_limit=cfg.admit_queue_limit, admit_max_age_ms=cfg.admit_max_age_ms,
         prefix_cache_blocks=cfg.prefix_cache_blocks,
         spec_decode_k=cfg.spec_decode_k, spec_max_active=cfg.spec_max_active,
+        restart_backoff_s=cfg.engine_restart_backoff_s,
+        restart_backoff_max_s=cfg.engine_restart_backoff_max_s,
+        max_restarts=cfg.engine_max_restarts,
+        restart_window_s=cfg.engine_restart_window_s,
     )
     worker = Worker(cfg, registry)
     await worker.start()

@@ -37,6 +37,7 @@ from ..models.llama import forward, make_cache
 from ..engine.sampling import sample_rows, spec_accept_rows
 from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
+from ..transport import faults as _faults
 from ..ops.kvcache import kv_copy_slice, kv_gather_block, kv_roll_s, kv_slice
 from .prefix_cache import PrefixCache
 from .spec import SpecConfig, SpecSlot, make_slot
@@ -95,6 +96,10 @@ class BatcherStats:
     ring_compactions: int = 0  # wrapped ring re-rolled to restore windows
     cancelled: int = 0  # consumer-gone requests whose slot/queue entry was freed
     shed: int = 0  # requests rejected at the depth bound or dropped at the age bound
+    # in-flight requests failed with a retryable envelope by a pump-loop
+    # crash (the supervisor's restart path harvests this into the registry
+    # accumulator behind lmstudio_inflight_failed_retryable_total)
+    inflight_failed_retryable: int = 0
     # speculative decoding (serve/spec.py): drafted = n-gram tokens sent to
     # verify dispatches, accepted = drafts the model's own distribution kept
     spec_verifies: int = 0  # width-(k+1) verify dispatches
@@ -180,6 +185,7 @@ class BatcherStats:
             "ring_compactions": self.ring_compactions,
             "cancelled": self.cancelled,
             "shed": self.shed,
+            "inflight_failed_retryable": self.inflight_failed_retryable,
         }
 
     def snapshot(self) -> dict:
@@ -199,6 +205,7 @@ class BatcherStats:
             "ring_compactions": self.ring_compactions,
             "cancelled": self.cancelled,
             "shed": self.shed,
+            "inflight_failed_retryable": self.inflight_failed_retryable,
             "spec_verifies": self.spec_verifies,
             "spec_drafted": self.spec_drafted,
             "spec_accepted": self.spec_accepted,
@@ -649,6 +656,13 @@ class ContinuousBatcher:
         # stopping-flag+sentinel so no request can slip into the inbox after
         # the final drain (submit would otherwise hang forever)
         self._submit_lock = threading.Lock()
+        # supervision surface (serve/worker.py watchdog): the owner thread
+        # stamps `heartbeat` once per main-loop iteration; `crashed` holds
+        # the exception that killed the pump loop, if any. The waitlist is
+        # an instance attr so a crash handler can fail waiters too.
+        self.heartbeat = time.monotonic()
+        self.crashed: BaseException | None = None
+        self._waitlist: list[_Request] = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -656,8 +670,79 @@ class ContinuousBatcher:
         if self._started:
             return
         self._started = True
-        self._thread = threading.Thread(target=self._run, name="batcher", daemon=True)
+        self._thread = threading.Thread(
+            target=self._run_guarded, name="batcher", daemon=True
+        )
         self._thread.start()
+
+    def _run_guarded(self) -> None:
+        """Owner-thread entry: a pump-loop escape (device fault, injected
+        chaos exception, bug) must not strand in-flight requests until their
+        client timeouts — capture it, fail every in-flight/queued request
+        with a *retryable* error, and leave the crash visible for the
+        worker's supervisor to restart this engine."""
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 — watchdogs need everything
+            self.crashed = e
+            log.exception("batcher pump loop crashed")
+            n = self._fail_inflight_retryable(e)
+            obs_emit(
+                "engine_crash", error=f"{type(e).__name__}: {e}",
+                inflight_failed=n,
+            )
+
+    def _fail_inflight_retryable(self, cause: BaseException) -> int:
+        """Fail every in-flight and queued request with a BatcherStopped
+        (its message carries the retryable marker, so clients with a
+        RetryPolicy re-issue to a queue-group peer). Returns the count."""
+        with self._submit_lock:
+            self._stopping = True  # no new submits past this point
+        err = BatcherStopped(
+            f"engine crashed ({type(cause).__name__}: {cause}); "
+            f"retry on another worker"
+        )
+        n = 0
+
+        def fail(req: _Request) -> None:
+            # count BEFORE emit: the emit wakes the consumer, which may read
+            # the stats counter (health/metrics scrape) immediately
+            nonlocal n
+            n += 1
+            self.stats.inflight_failed_retryable += 1
+            req.emit("err", err)
+
+        for req in self._waitlist:
+            fail(req)
+        self._waitlist.clear()
+        self._wl_len = 0
+        for i, req in enumerate(self._slots):
+            if isinstance(req, _Request):
+                fail(req)
+            self._slots[i] = None
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except _queue.Empty:
+                break
+            if req is not None:
+                fail(req)
+        return n
+
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the owner thread last topped its main loop. Only
+        meaningful while the batcher is NOT idle: a fully idle owner blocks
+        on the inbox and legitimately stops stamping."""
+        return time.monotonic() - self.heartbeat
+
+    @property
+    def alive(self) -> bool:
+        """True while the owner thread is running and has not crashed."""
+        return (
+            self.crashed is None
+            and self._thread is not None
+            and self._thread.is_alive()
+        )
 
     def stop(self) -> None:
         if not self._started or self._stopping:
@@ -1647,8 +1732,15 @@ class ContinuousBatcher:
             tok_dev = jnp.zeros((B,), jnp.int32)
 
         coalesce_s = self.admit_coalesce_ms / 1e3
-        waitlist: list[_Request] = []
+        # instance attr (not a local): a pump-loop crash must be able to
+        # fail waiters that have left the inbox but not yet won a slot
+        waitlist = self._waitlist
         while True:
+            self.heartbeat = time.monotonic()  # supervisor liveness stamp
+            if _faults.ACTIVE is not None:  # chaos harness; off ⇒ one attr read
+                f = _faults.ACTIVE.check(_faults.PUMP)
+                if f is not None and f.kind == "raise":
+                    raise f.exception()
             act = active()
             self.stats.peak_active = max(self.stats.peak_active, len(act))
             # intake: block when fully idle, otherwise just drain what's queued
@@ -1950,6 +2042,8 @@ class ContinuousBatcher:
         self._wl_len = 0
         for req in waitlist:
             req.emit("end", reason)
+        if isinstance(waitlist, list):
+            waitlist.clear()  # self._waitlist: a later crash must not re-fail these
         for i, req in enumerate(self._slots):
             if isinstance(req, _Request):
                 req.emit("end", reason)

@@ -21,6 +21,7 @@ from ..gguf.reader import open_gguf
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
+from ..obs import LogHistogram
 from ..obs import emit as obs_emit
 from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
@@ -280,6 +281,10 @@ class LocalRegistry(Registry):
         prefix_cache_blocks: int | None = None,
         spec_decode_k: int | None = None,
         spec_max_active: int | None = None,
+        restart_backoff_s: float = 0.5,
+        restart_backoff_max_s: float = 30.0,
+        max_restarts: int = 3,
+        restart_window_s: float = 120.0,
     ):
         self.store = store
         self.mesh = mesh
@@ -323,6 +328,23 @@ class LocalRegistry(Registry):
         # (_shrink_prefix_caches), unlike the weights/serving cache
         self._prefix_bytes: dict[str, int] = {}
         self.evict_grace_s = 1.0
+        # engine supervision (serve/worker.py watchdog → restart_engine):
+        # capped exponential restart backoff; > max_restarts crashes inside
+        # restart_window_s marks the engine POISONED — further get_engine
+        # calls are refused (retryable) until an operator delete/pull resets
+        # it, reusing the refuse-until-reset shape of the failed-load path
+        # in get_engine
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
+        self._crash_times: dict[str, list[float]] = {}
+        self._poisoned: dict[str, str] = {}  # model_id -> reason
+        self.engine_restarts_total = 0
+        # harvested from crashed batchers' stats at restart/teardown so the
+        # Prometheus total survives the batcher object being dropped
+        self.inflight_failed_retryable = 0
+        self.restart_latency_ms = LogHistogram()
 
     # -- Registry ------------------------------------------------------------
 
@@ -350,6 +372,9 @@ class LocalRegistry(Registry):
             _, transcript = await self.store.pull(identifier)
         except StoreError as e:
             raise EngineError(str(e)) from None
+        # a fresh pull is the other operator reset path for a poisoned model
+        self._poisoned.pop(identifier, None)
+        self._crash_times.pop(identifier, None)
         return transcript
 
     async def delete(self, model_id: str) -> str:
@@ -357,6 +382,9 @@ class LocalRegistry(Registry):
         self._hbm_committed.pop(model_id, None)
         self._prefix_bytes.pop(model_id, None)
         self._last_used.pop(model_id, None)
+        # operator reset path for a poisoned engine
+        self._poisoned.pop(model_id, None)
+        self._crash_times.pop(model_id, None)
         if eng is not None:
             await eng.unload()
             obs_emit("engine_unload", model=model_id, reason="delete")
@@ -376,6 +404,15 @@ class LocalRegistry(Registry):
 
     async def get_engine(self, model_id: str) -> ChatEngine:
         self._requests += 1
+        poisoned = self._poisoned.get(model_id)
+        if poisoned is not None:
+            # refuse-until-reset: delete or pull the model to clear. The
+            # message carries the retryable marker so a queue-group peer
+            # (whose copy may be healthy) gets the retry.
+            raise EngineError(
+                f"model {model_id} is poisoned ({poisoned}); delete or pull "
+                f"it to reset — retry on another worker"
+            )
         eng = self._engines.get(model_id)
         if eng is not None:
             self._last_used[model_id] = time.monotonic()
@@ -627,6 +664,84 @@ class LocalRegistry(Registry):
             model_id, batcher, tokenizer, cfg, meta, quantization="/".join(sorted(quant))
         )
 
+    # -- engine supervision ---------------------------------------------------
+
+    async def restart_engine(self, model_id: str, reason: str = "crash") -> str:
+        """Tear down and relaunch one engine (the worker supervisor's action
+        on a crashed or hung batcher). Returns "restarted", "poisoned" (too
+        many crashes inside the window — refuse-until-reset), or "gone" (the
+        engine was already unloaded by a concurrent delete/evict). A reload
+        failure propagates as EngineError after the teardown."""
+        t0 = time.monotonic()
+        async with self._load_lock:
+            eng = self._engines.pop(model_id, None)
+            if eng is None:
+                return "gone"
+            self._hbm_committed.pop(model_id, None)
+            self._prefix_bytes.pop(model_id, None)
+            self._last_used.pop(model_id, None)
+            b = eng.batcher
+            if b is not None:
+                # keep the Prometheus total alive past this batcher object
+                self.inflight_failed_retryable += getattr(
+                    b.stats, "inflight_failed_retryable", 0
+                )
+            await eng.unload()
+            obs_emit("engine_unload", model=model_id, reason=reason)
+            now = time.monotonic()
+            times = [
+                t for t in self._crash_times.get(model_id, [])
+                if now - t <= self.restart_window_s
+            ]
+            times.append(now)
+            self._crash_times[model_id] = times
+            if len(times) > self.max_restarts:
+                why = (
+                    f"{len(times)} crashes in {self.restart_window_s:.0f}s "
+                    f"(last: {reason})"
+                )
+                self._poisoned[model_id] = why
+                log.error("engine %s poisoned: %s", model_id, why)
+                obs_emit("engine_poisoned", model=model_id, reason=why)
+                return "poisoned"
+            backoff = min(
+                self.restart_backoff_s * (2 ** (len(times) - 1)),
+                self.restart_backoff_max_s,
+            )
+        # backoff + reload OUTSIDE the load lock: a long XLA reload must not
+        # block unrelated loads, and get_engine takes the lock itself
+        await asyncio.sleep(backoff)
+        await self.get_engine(model_id)
+        self.engine_restarts_total += 1
+        latency_ms = (time.monotonic() - t0) * 1e3
+        self.restart_latency_ms.record(latency_ms)
+        log.info("engine %s restarted in %.0f ms (reason: %s)",
+                 model_id, latency_ms, reason)
+        obs_emit("engine_restart", model=model_id, reason=reason,
+                 ms=round(latency_ms, 1))
+        return "restarted"
+
+    def engine_health(self) -> dict[str, dict[str, Any]]:
+        """Per-engine liveness/readiness for the health subject: ``alive``
+        (owner thread running, no crash), ``ready`` (alive and accepting
+        submits), ``heartbeat_age_s`` (staleness; only meaningful when the
+        batcher is not idle — an idle owner blocks on its inbox)."""
+        out: dict[str, dict[str, Any]] = {}
+        for mid, eng in self._engines.items():
+            b = eng.batcher
+            if b is None or not hasattr(b, "alive"):
+                continue
+            out[mid] = {
+                "alive": bool(b.alive),
+                "ready": bool(b.alive and not b._stopping),
+                "idle": bool(b.idle),
+                "heartbeat_age_s": round(b.heartbeat_age_s(), 3),
+            }
+        return out
+
+    def poisoned_models(self) -> dict[str, str]:
+        return dict(self._poisoned)
+
     def loaded_engines(self) -> dict[str, Any]:
         return dict(self._engines)
 
@@ -638,6 +753,10 @@ class LocalRegistry(Registry):
             "backend": jax.default_backend(),
             "hbm_committed_bytes": sum(self._hbm_committed.values()),
         }
+        if self.engine_restarts_total:
+            out["engine_restarts"] = self.engine_restarts_total
+        if self._poisoned:
+            out["poisoned"] = dict(self._poisoned)
         batchers = {
             mid: eng.batcher.stats.snapshot()
             for mid, eng in self._engines.items()

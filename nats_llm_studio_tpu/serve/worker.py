@@ -82,6 +82,7 @@ class Worker:
         self._requests_total = 0
         self._tokens_total = 0
         self._profiling = False
+        self._supervisor_task: asyncio.Task | None = None
         self._t0 = time.monotonic()
         # chat requests slower than this end-to-end land in the event ring
         # for post-hoc diagnosis (0 disables)
@@ -93,7 +94,14 @@ class Worker:
 
     async def start(self) -> None:
         cfg = self.config
-        self.nc = await connect(cfg.nats_url, name="tpu-worker")
+        self.nc = await connect(
+            cfg.nats_url,
+            name="tpu-worker",
+            max_reconnects=cfg.max_reconnects,
+            reconnect_wait_s=cfg.reconnect_wait_s,
+            reconnect_max_wait_s=cfg.reconnect_max_wait_s,
+            ping_interval_s=cfg.ping_interval_s,
+        )
         q = cfg.queue_group
         subs = {
             cfg.subject("list_models"): self.on_list_models,
@@ -110,6 +118,8 @@ class Worker:
         for subject, handler in subs.items():
             await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
         await self.nc.flush()
+        if cfg.supervise_interval_s > 0:
+            self._supervisor_task = asyncio.ensure_future(self._supervise())
         self._started.set()
         log.info("worker serving %s.* (queue=%s)", cfg.subject_prefix, q)
 
@@ -122,8 +132,53 @@ class Worker:
         self._stop.set()
 
     async def drain(self) -> None:
+        if self._supervisor_task is not None:
+            self._supervisor_task.cancel()
+            self._supervisor_task = None
         if self.nc is not None:
             await self.nc.drain()
+
+    async def _supervise(self) -> None:
+        """Engine watchdog: every ``supervise_interval_s`` check each loaded
+        batcher's owner thread — crashed (uncaught pump exception; its
+        in-flight slots were already failed retryable) or hung (heartbeat
+        stale while NOT idle; an idle owner blocks on its inbox and
+        legitimately stops stamping) — and hand unhealthy engines to the
+        registry's restart path (capped backoff; repeated crashes within the
+        window poison the model). The watchdog itself must never die: every
+        per-engine action is individually guarded."""
+        cfg = self.config
+        hb_timeout = cfg.engine_heartbeat_timeout_s
+        restart = getattr(self.registry, "restart_engine", None)
+        try:
+            while True:
+                await asyncio.sleep(cfg.supervise_interval_s)
+                for mid, eng in list(self.registry.loaded_engines().items()):
+                    b = getattr(eng, "batcher", None)
+                    if b is None or not hasattr(b, "alive"):
+                        continue  # fake/test engines have no pump loop
+                    try:
+                        dead = not b.alive
+                        hung = (
+                            not dead
+                            and hb_timeout > 0
+                            and not b.idle
+                            and b.heartbeat_age_s() > hb_timeout
+                        )
+                        if not dead and not hung:
+                            continue
+                        why = "crashed" if dead else (
+                            f"hung (heartbeat {b.heartbeat_age_s():.1f}s stale)"
+                        )
+                        log.warning("supervisor: engine %s %s", mid, why)
+                        EVENTS.emit("engine_supervisor", model=mid, state=why)
+                        if restart is not None:
+                            outcome = await restart(mid, reason=why)
+                            log.info("supervisor: engine %s -> %s", mid, outcome)
+                    except Exception:  # noqa: BLE001 — watchdog must survive
+                        log.exception("supervisor action for %s failed", mid)
+        except asyncio.CancelledError:
+            return
 
     def _guarded(self, handler):
         """Last-resort catch-all: the Go reference replies with an error
@@ -405,8 +460,21 @@ class Worker:
             "requests_total": self._requests_total,
             "tokens_total": self._tokens_total,
             "queue_group": self.config.queue_group,
+            "reconnects": getattr(self.nc, "reconnects", 0),
         }
         data.update(self.registry.stats())
+        # per-engine liveness/readiness (additive keys): lets clients and the
+        # bench route around a worker whose engine is restarting
+        health_fn = getattr(self.registry, "engine_health", None)
+        if health_fn is not None:
+            engines = health_fn()
+            if engines:
+                data["engines"] = engines
+        poisoned_fn = getattr(self.registry, "poisoned_models", None)
+        if poisoned_fn is not None:
+            poisoned = poisoned_fn()
+            if poisoned:
+                data["poisoned"] = sorted(poisoned)
         await self._respond_ok(msg, data)
 
     async def on_metrics(self, msg: Msg) -> None:
@@ -451,6 +519,29 @@ class Worker:
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 r.gauge(f"lmstudio_registry_{key}", v)
         r.gauge("lmstudio_events_emitted_total", EVENTS.emitted)
+        # fault-tolerance families — ALWAYS present (zero-valued when
+        # nothing has failed) so dashboards and the chaos tests can assert
+        # their existence, not just their increments
+        r.counter("lmstudio_reconnects_total", getattr(self.nc, "reconnects", 0),
+                  help="NATS connection re-establishments by this worker")
+        r.counter("lmstudio_engine_restarts_total",
+                  getattr(self.registry, "engine_restarts_total", 0),
+                  help="supervisor-driven engine restarts")
+        inflight_failed = getattr(self.registry, "inflight_failed_retryable", 0)
+        for eng in self.registry.loaded_engines().values():
+            stats = getattr(getattr(eng, "batcher", None), "stats", None)
+            # live batchers' counts; crashed ones were harvested into the
+            # registry accumulator at restart, so no double count
+            inflight_failed += getattr(stats, "inflight_failed_retryable", 0)
+        r.counter("lmstudio_inflight_failed_retryable_total", inflight_failed,
+                  help="in-flight requests failed with a retryable envelope "
+                       "by an engine crash")
+        poisoned_fn = getattr(self.registry, "poisoned_models", None)
+        if poisoned_fn is not None:
+            r.gauge("lmstudio_engines_poisoned", len(poisoned_fn()))
+        restart_hist = getattr(self.registry, "restart_latency_ms", None)
+        if restart_hist is not None:
+            r.histogram("lmstudio_engine_restart_ms", restart_hist.snapshot())
         for mid, eng in self.registry.loaded_engines().items():
             stats = getattr(getattr(eng, "batcher", None), "stats", None)
             if stats is None or not hasattr(stats, "histograms"):

@@ -1,13 +1,16 @@
-from .client import Msg, NatsClient, Subscription, connect
+from .client import ConnectionClosedError, Msg, NatsClient, RetryPolicy, Subscription, connect
 from .broker import EmbeddedBroker
-from .envelope import envelope_error, envelope_ok
+from .envelope import envelope_error, envelope_ok, is_retryable_envelope
 
 __all__ = [
+    "ConnectionClosedError",
     "Msg",
     "NatsClient",
+    "RetryPolicy",
     "Subscription",
     "connect",
     "EmbeddedBroker",
     "envelope_error",
     "envelope_ok",
+    "is_retryable_envelope",
 ]

@@ -13,6 +13,36 @@ from typing import Any
 
 FALLBACK = b'{"ok":false,"error":"internal serialization error"}'
 
+# error-message shapes that mean "this worker cannot serve the request right
+# now, but a queue-group peer (or this worker, shortly) can" — the single
+# source of truth shared by the worker (stamping ``retryable`` on envelopes)
+# and the client retry policy (recognizing unstamped legacy envelopes):
+# drain truncation (serve/registry.py), submit-after-stop, depth/age sheds
+# (serve/batcher.py), supervisor crash-failures and poisoned refusals.
+RETRYABLE_MARKERS = (
+    "retry on another worker",
+    "overloaded:",
+    "shed after",
+    "worker draining",
+)
+
+
+def error_is_retryable(error: str) -> bool:
+    """True when the error text matches a known transient/retryable shape."""
+    low = error.lower()
+    return any(m in low for m in RETRYABLE_MARKERS)
+
+
+def is_retryable_envelope(env: Any) -> bool:
+    """True for a decoded ``{ok: false, ...}`` envelope a client retry
+    policy may retry: either explicitly stamped ``retryable: true`` or
+    carrying a recognized retryable error message."""
+    if not isinstance(env, dict) or env.get("ok", False):
+        return False
+    if env.get("retryable"):
+        return True
+    return error_is_retryable(str(env.get("error", "")))
+
 
 def envelope_ok(data: Any = None, trace_id: str | None = None) -> bytes:
     env: dict[str, Any] = {"ok": True}
@@ -25,12 +55,24 @@ def envelope_ok(data: Any = None, trace_id: str | None = None) -> bytes:
     return _dump(env)
 
 
-def envelope_error(error: str, data: Any = None, trace_id: str | None = None) -> bytes:
+def envelope_error(
+    error: str,
+    data: Any = None,
+    trace_id: str | None = None,
+    retryable: bool | None = None,
+) -> bytes:
     env: dict[str, Any] = {"ok": False, "error": error}
     if data is not None:
         env["data"] = data
     if trace_id:
         env["trace_id"] = trace_id
+    if retryable is None:
+        retryable = error_is_retryable(error)
+    if retryable:
+        # additive field: only present (and true) on retryable errors, so
+        # the reference's byte-for-byte envelope shape is unchanged on every
+        # terminal error path
+        env["retryable"] = True
     return _dump(env)
 
 

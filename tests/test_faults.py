@@ -1,0 +1,690 @@
+"""Fault tolerance: deterministic chaos harness, transport reconnect,
+request retry, engine supervision and restart, poisoning.
+
+The acceptance flow (ISSUE 4): with a seeded FaultPlan severing the broker
+connection mid-run and injecting one pump-loop exception, every client
+request completes after automatic reconnect + retry — no lost or duplicated
+replies — and the lmstudio_reconnects_total / lmstudio_engine_restarts_total
+families appear on the Prometheus exposition.
+"""
+
+import asyncio
+import json
+import time
+
+import jax
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.engine.generator import SamplingParams
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.export import export_params_to_gguf
+from nats_llm_studio_tpu.models.llama import init_params
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.batcher import BatcherStopped, ContinuousBatcher
+from nats_llm_studio_tpu.serve.registry import LocalRegistry
+from nats_llm_studio_tpu.store.manager import ModelStore
+from nats_llm_studio_tpu.transport import (
+    ConnectionClosedError,
+    EmbeddedBroker,
+    RetryPolicy,
+    connect,
+    envelope_error,
+    envelope_ok,
+)
+from nats_llm_studio_tpu.transport import faults
+from nats_llm_studio_tpu.transport.envelope import is_retryable_envelope
+
+from conftest import async_test
+from fakes import FakeRegistry
+from test_serve_e2e import byte_level_tokenizer_md
+
+MID = "acme/tiny-faults"
+
+
+def _publish_tiny(models_dir, model_id=MID, seed=3):
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    d = models_dir / model_id
+    d.mkdir(parents=True, exist_ok=True)
+    export_params_to_gguf(
+        d / "m.gguf", params, cfg, name=model_id,
+        tokenizer_md=byte_level_tokenizer_md(cfg.vocab_size),
+    )
+    return cfg
+
+
+async def _wait_for(pred, timeout=15.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _chat_body(text, max_tokens=6, stream=False):
+    return json.dumps(
+        {
+            "model": MID,
+            "messages": [{"role": "user", "content": text}],
+            "max_tokens": max_tokens,
+            "temperature": 0.0,
+            "stream": stream,
+        }
+    ).encode()
+
+
+# -- FaultPlan units ---------------------------------------------------------
+
+
+def test_faultplan_step_indexing_fires_once():
+    plan = faults.FaultPlan(seed=1)
+    plan.drop(faults.BROKER_PUBLISH, step=2, subject="a.b")
+    # non-matching subjects never count against the rule
+    assert plan.check(faults.BROKER_PUBLISH, "other") is None
+    for i in range(2):  # hits 1, 2: below the 0-based step index
+        assert plan.check(faults.BROKER_PUBLISH, "a.b") is None, i
+    f = plan.check(faults.BROKER_PUBLISH, "a.b")  # hit 3 > step 2: fires
+    assert f is not None and f.kind == "drop"
+    # exactly once
+    assert plan.check(faults.BROKER_PUBLISH, "a.b") is None
+    assert plan.done()
+    assert plan.fired() == [
+        {"site": faults.BROKER_PUBLISH, "kind": "drop", "step": 2, "subject": "a.b"}
+    ]
+
+
+def test_faultplan_sites_are_independent():
+    plan = (
+        faults.FaultPlan()
+        .raise_at(faults.PUMP, step=0, message="boom")
+        .sever(faults.BROKER_PUBLISH, step=0)
+    )
+    assert not plan.done()
+    f = plan.check(faults.PUMP)
+    assert f is not None and isinstance(f.exception(), faults.InjectedFault)
+    assert str(f.exception()) == "boom"
+    assert not plan.done()  # sever has not fired yet
+    assert plan.check(faults.BROKER_PUBLISH, "x").kind == "sever"
+    assert plan.done()
+
+
+def test_faultplan_env_parsing():
+    env = {
+        "CHAOS_SPEC": (
+            "sever@broker.publish:3:subject=lmstudio.chat_model;"
+            "raise@batcher.pump:40:msg=injected;"
+            "delay@broker.publish:0:delay=0.25"
+        ),
+        "CHAOS_SEED": "9",
+    }
+    plan = faults.plan_from_env(env)
+    assert plan is not None and plan.seed == 9
+    kinds = [(f.kind, f.site, f.step) for f in plan.faults]
+    assert kinds == [
+        ("sever", "broker.publish", 3),
+        ("raise", "batcher.pump", 40),
+        ("delay", "broker.publish", 0),
+    ]
+    assert plan.faults[0].subject == "lmstudio.chat_model"
+    assert plan.faults[1].message == "injected"
+    assert plan.faults[2].delay_s == 0.25
+    assert faults.plan_from_env({}) is None
+    with pytest.raises(ValueError):
+        faults.plan_from_env({"CHAOS_SPEC": "explode@nowhere:1"})
+
+
+def test_retryable_envelope_detection():
+    assert is_retryable_envelope(
+        json.loads(envelope_error("worker draining, retry on another worker"))
+    )
+    assert is_retryable_envelope(json.loads(envelope_error("overloaded: full")))
+    # explicit stamp wins even for unrecognized text
+    assert is_retryable_envelope({"ok": False, "error": "custom", "retryable": True})
+    assert not is_retryable_envelope(json.loads(envelope_error("model not found: x")))
+    assert not is_retryable_envelope(json.loads(envelope_ok({"fine": 1})))
+    # the stamp is additive: only present on retryable errors
+    assert b"retryable" not in envelope_error("model not found: x")
+    assert json.loads(envelope_error("worker draining, retry on another worker"))[
+        "retryable"
+    ] is True
+
+
+# -- fail-fast closed-connection errors (satellite 2) ------------------------
+
+
+@async_test
+async def test_flush_and_request_fail_fast_when_connection_gone():
+    broker = await EmbeddedBroker().start()
+    nc = await connect(broker.url, max_reconnects=0)  # reconnect disabled
+    await broker.stop()
+    await _wait_for(lambda: nc._closed.is_set(), what="client close on EOF")
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionClosedError):
+        await nc.flush(timeout=30.0)
+    with pytest.raises(ConnectionClosedError):
+        await nc.request("any.subject", b"{}", timeout=30.0)
+    # the whole point: errors surface immediately, not after the timeouts
+    assert time.monotonic() - t0 < 5.0
+    await nc.close()
+
+
+@async_test
+async def test_inflight_request_fails_fast_on_disconnect():
+    """A request already waiting for its reply must fail the moment the
+    connection drops (so a retry policy can re-issue after reconnect),
+    not wait out its full timeout."""
+    broker = await EmbeddedBroker().start()
+    try:
+        nc = await connect(broker.url, max_reconnects=0)
+        task = asyncio.ensure_future(
+            nc.request("nobody.listens", b"", timeout=30.0)
+        )
+        await asyncio.sleep(0.1)  # request published, future parked
+        await broker.stop()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionClosedError):
+            await task
+        assert time.monotonic() - t0 < 5.0
+        await nc.close()
+    finally:
+        await broker.stop()
+
+
+# -- reconnect: resubscribe + pending-publish buffer -------------------------
+
+
+@async_test
+async def test_reconnect_restores_subscriptions_and_flushes_buffered_publishes():
+    broker = await EmbeddedBroker().start()
+    plan = faults.install(faults.FaultPlan(seed=0).sever(faults.BROKER_PUBLISH, 0, subject="kill.me"))
+    try:
+        nc = await connect(broker.url, reconnect_wait_s=0.02, reconnect_max_wait_s=0.1)
+        sub = await nc.subscribe("t.data")
+        await nc.flush()
+        await nc.publish("kill.me", b"")  # broker severs OUR connection
+        await _wait_for(lambda: not nc.is_connected or nc.reconnects >= 1,
+                        what="disconnect noticed")
+        # published while down: buffered, flushed on the fresh connection
+        await nc.publish("t.data", b"after-reconnect")
+        await _wait_for(lambda: nc.reconnects >= 1, what="reconnect")
+        msg = await sub.next_msg(timeout=10)  # sub was re-issued automatically
+        assert msg.payload == b"after-reconnect"
+        assert nc.reconnects == 1
+        assert nc.last_reconnect_s > 0
+        assert plan.done()
+        await nc.flush()  # fresh connection round-trips
+        await nc.close()
+    finally:
+        faults.clear()
+        await broker.stop()
+
+
+@async_test
+async def test_stream_fails_fast_on_mid_stream_disconnect():
+    """request_stream must raise ConnectionClosedError on a reconnect gap —
+    replies published while the link was down are gone; idling out (or
+    silently resuming with missing chunks) would be data loss."""
+    broker = await EmbeddedBroker().start()
+    faults.install(faults.FaultPlan().sever(faults.BROKER_PUBLISH, 0, subject="kill.me"))
+    try:
+        nc = await connect(broker.url, reconnect_wait_s=0.02)
+        responder = await connect(broker.url)
+
+        async def on_req(msg):
+            await responder.publish(msg.reply, b'{"chunk":1}')  # no Done header
+
+        await responder.subscribe("svc.stream", cb=on_req)
+        await responder.flush()
+        agen = nc.request_stream("svc.stream", b"", timeout=20, idle_timeout=15)
+        first = await agen.__anext__()
+        assert json.loads(first.payload) == {"chunk": 1}
+        await nc.publish("kill.me", b"")  # sever mid-stream
+        with pytest.raises(ConnectionClosedError):
+            await agen.__anext__()
+        await nc.close()
+        await responder.close()
+    finally:
+        faults.clear()
+        await broker.stop()
+
+
+# -- request retry policy ----------------------------------------------------
+
+
+@async_test
+async def test_request_retries_on_retryable_envelope():
+    broker = await EmbeddedBroker().start()
+    try:
+        server = await connect(broker.url)
+        calls = {"n": 0}
+
+        async def handler(msg):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                await msg.respond(
+                    envelope_error("worker draining, retry on another worker")
+                )
+            else:
+                await msg.respond(envelope_ok({"served_on_attempt": calls["n"]}))
+
+        await server.subscribe("svc.flaky", cb=handler)
+        await server.flush()
+        nc = await connect(broker.url)
+
+        # no retry: the retryable error envelope is returned as-is
+        env = json.loads((await nc.request("svc.flaky", b"", timeout=5)).payload)
+        assert env["ok"] is False and env["retryable"] is True
+        calls["n"] = 0
+
+        env = json.loads(
+            (
+                await nc.request(
+                    "svc.flaky", b"", timeout=5,
+                    retry=RetryPolicy(max_attempts=4, backoff_s=0.01),
+                )
+            ).payload
+        )
+        assert env["ok"] is True
+        assert env["data"]["served_on_attempt"] == 3
+        await nc.close()
+        await server.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_request_retry_returns_final_envelope_honestly():
+    broker = await EmbeddedBroker().start()
+    try:
+        server = await connect(broker.url)
+
+        async def always_drain(msg):
+            await msg.respond(envelope_error("worker draining, retry on another worker"))
+
+        await server.subscribe("svc.alwaysdrain", cb=always_drain)
+        await server.flush()
+        nc = await connect(broker.url)
+        env = json.loads(
+            (
+                await nc.request(
+                    "svc.alwaysdrain", b"", timeout=5,
+                    retry=RetryPolicy(max_attempts=2, backoff_s=0.01),
+                )
+            ).payload
+        )
+        # attempts exhausted: the last (still retryable) envelope is returned,
+        # not swallowed into an exception
+        assert env["ok"] is False and env["retryable"] is True
+        await nc.close()
+        await server.close()
+    finally:
+        await broker.stop()
+
+
+# -- batcher pump crash ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@async_test
+async def test_pump_crash_fails_inflight_with_retryable_error(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    # fire a few iterations in: the request is admitted and decoding
+    faults.install(faults.FaultPlan().raise_at(faults.PUMP, step=4))
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=50)
+        with pytest.raises(BatcherStopped) as ei:
+            async for _ in b.submit([1, 2, 3], sp):
+                pass
+        assert "retry on another worker" in str(ei.value)
+        assert not b.alive
+        assert isinstance(b.crashed, faults.InjectedFault)
+        assert b.stats.inflight_failed_retryable >= 1
+        # slots cleared: the registry's eviction view stays sane
+        await _wait_for(lambda: b.idle, what="slots cleared after crash")
+        # submits after the crash are refused retryable, not hung
+        with pytest.raises(BatcherStopped):
+            async for _ in b.submit([4], sp):
+                pass
+    finally:
+        faults.clear()
+        b.stop()
+
+
+# -- worker supervisor -------------------------------------------------------
+
+
+class _DeadBatcher:
+    alive = False
+    idle = True
+    _stopping = True
+
+    def heartbeat_age_s(self):
+        return 0.0
+
+
+class _HungBatcher:
+    alive = True
+    idle = False
+    _stopping = False
+
+    def heartbeat_age_s(self):
+        return 999.0
+
+
+class _Eng:
+    def __init__(self, batcher):
+        self.batcher = batcher
+
+
+class _SupervisedReg(FakeRegistry):
+    def __init__(self, batcher):
+        super().__init__(models=["m"])
+        self._batcher = batcher
+        self.restarts = []
+
+    def loaded_engines(self):
+        return {"m": _Eng(self._batcher)}
+
+    async def restart_engine(self, model_id, reason="crash"):
+        self.restarts.append((model_id, reason))
+        return "restarted"
+
+
+@async_test
+async def test_supervisor_restarts_crashed_and_hung_engines():
+    broker = await EmbeddedBroker().start()
+    try:
+        for batcher, expect in ((_DeadBatcher(), "crashed"), (_HungBatcher(), "hung")):
+            reg = _SupervisedReg(batcher)
+            cfg = WorkerConfig(
+                nats_url=broker.url, supervise_interval_s=0.05,
+                engine_heartbeat_timeout_s=1.0,
+            )
+            w = Worker(cfg, reg)
+            await w.start()
+            await _wait_for(lambda: reg.restarts, what=f"supervisor restart ({expect})")
+            assert reg.restarts[0][0] == "m"
+            assert expect in reg.restarts[0][1]
+            await w.drain()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_supervisor_ignores_healthy_and_idle_engines(model):
+    """An idle batcher blocks on its inbox and stops stamping its heartbeat —
+    the supervisor must not flag it hung (the `not idle` guard)."""
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    try:
+        out = [t async for t in b.submit([1, 2], SamplingParams(temperature=0.0, max_tokens=2))]
+        assert len(out) == 2
+        await asyncio.sleep(0.3)  # idle: heartbeat goes stale
+        assert b.alive and b.idle
+        broker = await EmbeddedBroker().start()
+        try:
+            reg = _SupervisedReg(b)
+            w = Worker(
+                WorkerConfig(
+                    nats_url=broker.url, supervise_interval_s=0.05,
+                    engine_heartbeat_timeout_s=0.1,  # << the idle staleness
+                ),
+                reg,
+            )
+            await w.start()
+            await asyncio.sleep(0.4)
+            assert reg.restarts == []  # alive + idle: never restarted
+            await w.drain()
+        finally:
+            await broker.stop()
+    finally:
+        b.stop()
+
+
+# -- worker drain with a chat in flight (satellite 3) ------------------------
+
+
+@async_test
+async def test_drain_midflight_yields_clean_retryable_envelope_and_retry_recovers(tmp_path):
+    models = tmp_path / "models"
+    _publish_tiny(models)
+    broker = await EmbeddedBroker().start()
+    try:
+        reg_a = LocalRegistry(
+            ModelStore(models), dtype="float32", max_batch_slots=1, max_seq_len=64
+        )
+        worker_a = Worker(WorkerConfig(nats_url=broker.url), reg_a)
+        await worker_a.start()
+        nc = await connect(broker.url)
+        eng_a = await reg_a.get_engine(MID)
+
+        # occupy worker A's single slot with a long chat...
+        blocker = asyncio.ensure_future(
+            nc.request("lmstudio.chat_model", _chat_body("blocker", max_tokens=50),
+                       timeout=60)
+        )
+        await _wait_for(
+            lambda: any(s is not None for s in eng_a.batcher._slots),
+            what="blocker admitted to a slot",
+        )
+        # ...so the victim chat queues behind it
+        victim = asyncio.ensure_future(
+            nc.request("lmstudio.chat_model", _chat_body("victim", max_tokens=4),
+                       timeout=60)
+        )
+        await _wait_for(
+            lambda: eng_a.batcher._inbox.qsize() + eng_a.batcher._wl_len >= 1,
+            what="victim queued",
+        )
+        # drain: the engine stops with the victim still queued, zero tokens out
+        await asyncio.to_thread(eng_a.batcher.stop)
+
+        env = json.loads((await victim).payload)
+        assert env["ok"] is False
+        assert "worker draining, retry on another worker" in env["error"]
+        assert env["retryable"] is True  # the client retry policy's signal
+        assert is_retryable_envelope(env)
+        # the blocker had tokens in flight: truncated honestly, not errored
+        blocker_env = json.loads((await blocker).payload)
+        assert blocker_env["ok"] is True
+        finish = blocker_env["data"]["response"]["choices"][0]["finish_reason"]
+        assert finish == "shutdown"
+
+        # end-to-end recovery: a healthy queue-group peer + client retry.
+        # Worker A still answers with retryable envelopes (stopped engine),
+        # so attempts bounce until one lands on worker B — bounded by the
+        # retry budget, which makes the overall chance of failure ~2^-19.
+        reg_b = LocalRegistry(
+            ModelStore(models), dtype="float32", max_batch_slots=2, max_seq_len=64
+        )
+        worker_b = Worker(WorkerConfig(nats_url=broker.url), reg_b)
+        await worker_b.start()
+        env = json.loads(
+            (
+                await nc.request(
+                    "lmstudio.chat_model", _chat_body("retry me", max_tokens=4),
+                    timeout=60,
+                    retry=RetryPolicy(max_attempts=20, backoff_s=0.02, max_backoff_s=0.2),
+                )
+            ).payload
+        )
+        assert env["ok"] is True, env
+        await nc.close()
+        await worker_a.drain()
+        await worker_b.drain()
+    finally:
+        await broker.stop()
+
+
+# -- acceptance: seeded chaos end-to-end -------------------------------------
+
+
+@async_test
+async def test_chaos_sever_and_pump_crash_full_recovery(tmp_path):
+    """The ISSUE 4 acceptance flow: one seeded plan severs the requester's
+    broker connection on the 3rd chat publish AND raises one injected
+    exception inside the batcher pump loop. Every request must complete
+    (reconnect + retry + supervisor engine restart), and the reconnect /
+    restart counter families must appear on the Prometheus exposition."""
+    models = tmp_path / "models"
+    _publish_tiny(models)
+    broker = await EmbeddedBroker().start()
+    try:
+        reg = LocalRegistry(
+            ModelStore(models), dtype="float32", max_batch_slots=2, max_seq_len=64,
+            restart_backoff_s=0.05, restart_backoff_max_s=0.2,
+            max_restarts=10, restart_window_s=60.0,
+        )
+        worker = Worker(
+            WorkerConfig(
+                nats_url=broker.url, supervise_interval_s=0.1,
+                engine_heartbeat_timeout_s=0.0,  # crash detection only
+            ),
+            reg,
+        )
+        await worker.start()
+        nc = await connect(broker.url, reconnect_wait_s=0.02, reconnect_max_wait_s=0.2)
+
+        # warm the engine outside the plan so fault steps land in serving
+        env = json.loads(
+            (await nc.request("lmstudio.chat_model", _chat_body("warmup"), timeout=60)).payload
+        )
+        assert env["ok"] is True, env
+
+        plan = faults.install(
+            faults.FaultPlan(seed=11)
+            .sever(faults.BROKER_PUBLISH, 2, subject="lmstudio.chat_model")
+            # ~2-3 checked pump iterations serve one short request (decode is
+            # bursted), so step 8 lands mid-run of the 6-request loop
+            .raise_at(faults.PUMP, 8, message="chaos pump fault")
+        )
+        retry = RetryPolicy(
+            max_attempts=12, backoff_s=0.2, max_backoff_s=1.0, retry_on_timeout=True
+        )
+        n_ok = 0
+        for i in range(6):
+            msg = await nc.request(
+                "lmstudio.chat_model", _chat_body(f"request {i}"), timeout=30,
+                retry=retry,
+            )
+            env = json.loads(msg.payload)
+            assert env["ok"] is True, (i, env)
+            # exactly one terminal completion per request, never a duplicate
+            assert env["data"]["response"]["object"] == "chat.completion"
+            n_ok += 1
+        assert n_ok == 6
+        assert plan.done(), plan.describe()  # both faults actually fired
+        assert nc.reconnects >= 1  # the sever was absorbed by a reconnect
+        assert reg.engine_restarts_total >= 1  # the crash by a restart
+
+        # now crash deterministically MID-REQUEST: the batcher is idle (its
+        # current iteration's fault check already ran), so a step-0 raise
+        # fires on the next checked iteration — with the long request below
+        # either in a slot or still queued, and both paths count it
+        restarts_before = reg.engine_restarts_total
+        faults.install(faults.FaultPlan().raise_at(faults.PUMP, 0, message="mid-flight"))
+        env = json.loads(
+            (
+                await nc.request(
+                    "lmstudio.chat_model", _chat_body("victim", max_tokens=50),
+                    timeout=30,
+                )
+            ).payload
+        )
+        assert env["ok"] is False and env["retryable"] is True, env
+        assert "retry on another worker" in env["error"]
+        await _wait_for(
+            lambda: reg.engine_restarts_total > restarts_before,
+            what="supervisor restart after mid-flight crash",
+        )
+
+        # health reports the relaunched engine live again
+        health = json.loads((await nc.request("lmstudio.health", b"", timeout=10)).payload)
+        assert health["data"]["engines"][MID]["alive"] is True
+        assert health["data"]["engines"][MID]["ready"] is True
+
+        prom = (await nc.request("lmstudio.metrics.prom", b"", timeout=10)).payload.decode()
+        assert "lmstudio_reconnects_total" in prom
+        assert "lmstudio_inflight_failed_retryable_total" in prom
+        restarts = [
+            line for line in prom.splitlines()
+            if line.startswith("lmstudio_engine_restarts_total")
+        ]
+        assert restarts and float(restarts[0].split()[-1]) >= 1
+        inflight = [
+            line for line in prom.splitlines()
+            if line.startswith("lmstudio_inflight_failed_retryable_total")
+        ]
+        assert inflight and float(inflight[0].split()[-1]) >= 1
+        assert "lmstudio_engine_restart_ms" in prom
+
+        await nc.close()
+        await worker.drain()
+    finally:
+        faults.clear()
+        await broker.stop()
+
+
+# -- poisoning ---------------------------------------------------------------
+
+
+@async_test
+async def test_repeated_crashes_poison_engine_until_reset(tmp_path):
+    models = tmp_path / "models"
+    _publish_tiny(models)
+    reg = LocalRegistry(
+        ModelStore(models), dtype="float32", max_batch_slots=2, max_seq_len=64,
+        max_restarts=0,  # the very first crash poisons
+    )
+    await reg.get_engine(MID)
+    outcome = await reg.restart_engine(MID, reason="test crash")
+    assert outcome == "poisoned"
+    assert MID in reg.poisoned_models()
+    assert reg.loaded_engines() == {}  # torn down, not relaunched
+    with pytest.raises(Exception) as ei:
+        await reg.get_engine(MID)
+    assert "poisoned" in str(ei.value)
+    # the refusal itself is retryable: a queue-group peer may be healthy
+    assert is_retryable_envelope(json.loads(envelope_error(str(ei.value))))
+    assert "poisoned" in reg.stats()
+    # operator reset path: delete clears the poison mark (and the files)
+    await reg.delete(MID)
+    assert reg.poisoned_models() == {}
+    from nats_llm_studio_tpu.serve.api import ModelNotFound
+
+    with pytest.raises(ModelNotFound):
+        await reg.get_engine(MID)
+
+
+@async_test
+async def test_restart_engine_relaunches_below_poison_threshold(tmp_path):
+    models = tmp_path / "models"
+    _publish_tiny(models)
+    reg = LocalRegistry(
+        ModelStore(models), dtype="float32", max_batch_slots=2, max_seq_len=64,
+        restart_backoff_s=0.01, max_restarts=3,
+    )
+    await reg.get_engine(MID)
+    outcome = await reg.restart_engine(MID, reason="crash")
+    assert outcome == "restarted"
+    assert reg.engine_restarts_total == 1
+    assert reg.restart_latency_ms.snapshot().count == 1
+    # the relaunched engine serves
+    eng = await reg.get_engine(MID)
+    out = await eng.chat(
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3,
+         "temperature": 0.0}
+    )
+    assert out["choices"][0]["message"]["content"] is not None
+    health = reg.engine_health()
+    assert health[MID]["alive"] and health[MID]["ready"]
+    await reg.restart_engine(MID, reason="cleanup-stop")  # tidy teardown
