@@ -134,6 +134,26 @@ class WorkerConfig:
     engine_restart_window_s: float = field(
         default_factory=lambda: float(_env("ENGINE_RESTART_WINDOW_S", "120"))
     )
+    # -- overload robustness (serve/brownout.py + serve/batcher.py) ----------
+    # end-to-end deadline propagation: request()/request_stream() stamp the
+    # caller's budget as X-Deadline-Ms; the worker converts it to a monotonic
+    # deadline (capped by chat_timeout_s) so the batcher can shed expired
+    # requests before prefill and abort mid-decode slots whose caller gave
+    # up. DEADLINE_PROPAGATION=0/false/off disables the worker-side half
+    # (clients still stamp the cheap header). DEADLINE_MIN_TOKENS and the
+    # BROWNOUT_* thresholds parse in serve/registry.py.
+    deadline_propagation: bool = field(
+        default_factory=lambda: _env("DEADLINE_PROPAGATION", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    # adaptive brownout controller: NORMAL → BROWNOUT → SHED_ONLY with
+    # hysteresis on queue depth, queue age p95, and HBM headroom.
+    # BROWNOUT=0/false/off disables (batcher falls back to the binary
+    # depth/age sheds only).
+    brownout: bool = field(
+        default_factory=lambda: _env("BROWNOUT", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

@@ -86,6 +86,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
         admit_queue_limit=cfg.admit_queue_limit, admit_max_age_ms=cfg.admit_max_age_ms,
         prefix_cache_blocks=cfg.prefix_cache_blocks,
         spec_decode_k=cfg.spec_decode_k, spec_max_active=cfg.spec_max_active,
+        brownout=cfg.brownout,
         restart_backoff_s=cfg.engine_restart_backoff_s,
         restart_backoff_max_s=cfg.engine_restart_backoff_max_s,
         max_restarts=cfg.engine_max_restarts,

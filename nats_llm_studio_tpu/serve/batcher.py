@@ -39,6 +39,7 @@ from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
 from ..transport import faults as _faults
 from ..ops.kvcache import kv_copy_slice, kv_gather_block, kv_roll_s, kv_slice
+from .brownout import SHED_ONLY, BrownoutConfig, BrownoutController
 from .prefix_cache import PrefixCache
 from .spec import SpecConfig, SpecSlot, make_slot
 
@@ -80,6 +81,14 @@ class _Request:
     # gone — the owner thread frees the slot/queue entry at its next check
     # instead of decoding to max_tokens for nobody (VERDICT r4 missing #1)
     cancelled: bool = False
+    # absolute monotonic deadline propagated from the client's budget
+    # (None = no deadline); past it the request is shed before prefill or
+    # cooperatively aborted mid-decode instead of burning device time for
+    # a caller that has already given up
+    deadline: float | None = None
+    # distinguishes a deadline abort from a consumer-gone cancel when the
+    # owner thread frees the slot (cause tag in cancel_causes/prometheus)
+    deadline_hit: bool = False
 
     def emit(self, kind: str, value) -> None:
         self.loop.call_soon_threadsafe(self.out.put_nowait, (kind, value))
@@ -121,7 +130,8 @@ class BatcherStats:
     spec_accept_rate: LogHistogram = field(
         default_factory=lambda: LogHistogram(lo=0.01, hi=1.0, growth=1.25)
     )
-    shed_causes: dict = field(default_factory=dict)  # "depth" | "age" -> count
+    # "depth" | "age" | "deadline" | "brownout" -> count
+    shed_causes: dict = field(default_factory=dict)
     cancel_causes: dict = field(default_factory=dict)  # where the cancel landed
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
@@ -244,6 +254,9 @@ class ContinuousBatcher:
         prefix_cache_blocks: int = 0,
         spec_decode_k: int = 0,
         spec_max_active: int = 4,
+        brownout: BrownoutConfig | None = None,
+        hbm_headroom_fn=None,
+        deadline_min_tokens: int = 1,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -325,6 +338,25 @@ class ContinuousBatcher:
             if spec_decode_k > 0
             else None
         )
+        # adaptive brownout (serve/brownout.py): ticked by the owner thread
+        # each main-loop iteration; None = off (every lever stays nominal).
+        # ``hbm_headroom_fn`` is injected by the registry (the batcher has
+        # no handle on HBM accounting) and returns the free-fraction of the
+        # HBM budget, or None when no budget is configured.
+        self.brownout: BrownoutController | None = (
+            BrownoutController(brownout) if brownout is not None else None
+        )
+        self.hbm_headroom_fn = hbm_headroom_fn
+        # deadline feasibility floor: a request that cannot produce at least
+        # min(deadline_min_tokens, its max_tokens) before its deadline —
+        # estimated from the live prefill/decode rate EWMAs — is shed before
+        # prefill instead of admitted to be aborted mid-stream
+        self.deadline_min_tokens = max(1, deadline_min_tokens)
+        # live rate EWMAs (owner thread only): prefill tokens/s measured at
+        # first token, decode seconds/token measured per burst readback.
+        # 0.0 = no sample yet (feasibility then only sheds the already-expired)
+        self._prefill_rate_ewma = 0.0
+        self._decode_spt_ewma = 0.0
         self.stats = BatcherStats()
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
@@ -729,6 +761,38 @@ class ContinuousBatcher:
                 fail(req)
         return n
 
+    @property
+    def brownout_level(self) -> int:
+        """Current degradation level (0 normal / 1 brownout / 2 shed-only);
+        0 when the controller is off. Plain int read — safe cross-thread."""
+        return self.brownout.level if self.brownout is not None else 0
+
+    def _note_prefill_rate(self, tokens: int, seconds: float) -> None:
+        if seconds <= 0 or tokens <= 0:
+            return
+        rate = tokens / seconds
+        prev = self._prefill_rate_ewma
+        self._prefill_rate_ewma = rate if prev == 0.0 else 0.8 * prev + 0.2 * rate
+
+    def _note_decode_spt(self, step_seconds: float) -> None:
+        if step_seconds <= 0:
+            return
+        prev = self._decode_spt_ewma
+        self._decode_spt_ewma = (
+            step_seconds if prev == 0.0 else 0.8 * prev + 0.2 * step_seconds
+        )
+
+    def _estimate_serve_s(self, req: _Request) -> float:
+        """Seconds to prefill ``req`` and decode its feasibility floor of
+        tokens, from the live rate EWMAs (0.0 while cold — no sample means
+        no informed shed, only already-expired ones)."""
+        est = 0.0
+        if self._prefill_rate_ewma > 0.0:
+            est += len(req.prompt_ids) / self._prefill_rate_ewma
+        min_tok = max(1, min(self.deadline_min_tokens, req.sp.max_tokens))
+        est += min_tok * self._decode_spt_ewma
+        return est
+
     def heartbeat_age_s(self) -> float:
         """Seconds since the owner thread last topped its main loop. Only
         meaningful while the batcher is NOT idle: a fully idle owner blocks
@@ -832,7 +896,11 @@ class ContinuousBatcher:
     # -- client API ----------------------------------------------------------
 
     def _enqueue(
-        self, prompt_ids: list[int], sp: SamplingParams, trace: Trace | None = None
+        self,
+        prompt_ids: list[int],
+        sp: SamplingParams,
+        trace: Trace | None = None,
+        deadline: float | None = None,
     ) -> _Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -845,16 +913,55 @@ class ContinuousBatcher:
             out=asyncio.Queue(),
             t_enq=time.monotonic(),
             trace=trace,
+            deadline=deadline,
         )
         if trace is not None:
             trace.mark("enqueue", req.t_enq)
+        # expired before it was even queued: shed at submit, zero device work
+        # (the caller's budget is gone — serving it helps nobody)
+        if deadline is not None and req.t_enq >= deadline:
+            self.stats.record_shed("deadline")
+            raise BatcherOverloaded(
+                "deadline already expired at submit; retry on another worker"
+            )
+        bo = self.brownout
         with self._submit_lock:
             if self._stopping:
                 raise BatcherStopped("batcher is stopped; retry on another worker")
-            if self.max_queue and self._inbox.qsize() + self._wl_len >= self.max_queue:
+            if bo is not None and bo.level >= SHED_ONLY and self.idle:
+                # the owner loop only ticks the controller while it has work;
+                # a fully drained pipeline parks it on the inbox, and a bounce
+                # below never wakes it — the level would be stuck at shed-only
+                # forever. Tick from the submit path with the current (calm)
+                # signals so sustained retry traffic can step the level down.
+                headroom = None
+                if self.hbm_headroom_fn is not None:
+                    try:
+                        headroom = self.hbm_headroom_fn()
+                    except Exception:  # noqa: BLE001 — probe is best-effort
+                        headroom = None
+                bo.update(
+                    depth_frac=self._inbox.qsize()
+                    / (self.max_queue or 4 * self.max_slots),
+                    age_p95_ms=0.0,
+                    hbm_headroom_frac=headroom,
+                )
+            if bo is not None and bo.level >= SHED_ONLY:
+                # shed-only brownout: queued work drains, new work bounces
+                # immediately with a retryable envelope
+                self.stats.record_shed("brownout")
+                raise BatcherOverloaded(
+                    "brownout shed-only: worker saturated; retry on another worker"
+                )
+            limit = (
+                bo.effective_queue_limit(self.max_queue)
+                if bo is not None
+                else self.max_queue
+            )
+            if limit and self._inbox.qsize() + self._wl_len >= limit:
                 self.stats.record_shed("depth")
                 raise BatcherOverloaded(
-                    f"admit queue full ({self.max_queue} waiting); retry on "
+                    f"admit queue full ({limit} waiting); retry on "
                     f"another worker"
                 )
             self._inbox.put(req)
@@ -873,14 +980,19 @@ class ContinuousBatcher:
         sp: SamplingParams,
         info: dict | None = None,
         trace: Trace | None = None,
+        deadline: float | None = None,
     ) -> AsyncIterator[int]:
         """Yield generated token ids for one request.
 
         When ``info`` is given, the batcher's end reason ("stop" / "length" /
         "shutdown") is recorded in ``info["finish_reason"]`` so callers report
         cache-capacity terminations truthfully instead of re-deriving from
-        token counts."""
-        async for batch in self.submit_batched(prompt_ids, sp, info=info, trace=trace):
+        token counts. ``deadline`` is an absolute ``time.monotonic()`` value
+        (the client's propagated budget): past it the request is shed before
+        prefill or cooperatively aborted mid-decode."""
+        async for batch in self.submit_batched(
+            prompt_ids, sp, info=info, trace=trace, deadline=deadline
+        ):
             for tok in batch:
                 yield tok
 
@@ -890,6 +1002,7 @@ class ContinuousBatcher:
         sp: SamplingParams,
         info: dict | None = None,
         trace: Trace | None = None,
+        deadline: float | None = None,
     ) -> AsyncIterator[list[int]]:
         """Like ``submit`` but yields LISTS of tokens: everything already
         delivered when the consumer wakes comes out as one batch. A decode
@@ -901,7 +1014,7 @@ class ContinuousBatcher:
             self.start()
         if not prompt_ids:
             return
-        req = self._enqueue(prompt_ids, sp, trace=trace)
+        req = self._enqueue(prompt_ids, sp, trace=trace, deadline=deadline)
         done = False
         try:
             while True:
@@ -1037,15 +1150,17 @@ class ContinuousBatcher:
                 ids = np.asarray(toks_ref)  # ONE [B, n] readback per burst
                 # observed per-step latency (dispatch -> tokens readable);
                 # includes pipeline wait, i.e. what a stream experiences
-                self.stats.decode_step_ms.record(
-                    (time.monotonic() - t_disp) * 1e3 / n
-                )
+                step_s = (time.monotonic() - t_disp) / n
+                self.stats.decode_step_ms.record(step_s * 1e3)
+                self._note_decode_spt(step_s)
                 for slot, req in rows:
                     if self._slots[slot] is not req:
                         continue  # finished at an earlier record; zombie rows
                     if req.cancelled:
                         finish_slot(slot)
-                        self.stats.record_cancel("decode")
+                        self.stats.record_cancel(
+                            "deadline" if req.deadline_hit else "decode"
+                        )
                         continue
                     st = spec_slots[slot]
                     try:
@@ -1083,7 +1198,9 @@ class ContinuousBatcher:
                         )
                     if req.cancelled:
                         finish_slot(slot)
-                        self.stats.record_cancel("decode")
+                        self.stats.record_cancel(
+                            "deadline" if req.deadline_hit else "decode"
+                        )
                         continue
                     st = spec_slots[slot]
                     try:
@@ -1108,7 +1225,9 @@ class ContinuousBatcher:
                         continue
                     if req.cancelled:
                         finish_slot(slot)
-                        self.stats.record_cancel("admit")
+                        self.stats.record_cancel(
+                            "deadline" if req.deadline_hit else "admit"
+                        )
                         continue
                     try:
                         first = int(ids[row])
@@ -1214,7 +1333,15 @@ class ContinuousBatcher:
             # the extra decode computes a token nobody delivers — headroom
             # may be <= 0 here and n=1 covers it.
             headroom = self.max_seq - 1 - max(host_pos[i] for i in act)
-            n = self.decode_burst if headroom >= self.decode_burst else 1
+            # brownout shrinks the burst (shorter dispatch windows → faster
+            # shed/abort reaction under pressure); n stays a static jit arg
+            # from a tiny set {burst, burst//2, 1}, so compiles stay bounded
+            burst = (
+                self.brownout.effective_burst(self.decode_burst)
+                if self.brownout is not None
+                else self.decode_burst
+            )
+            n = burst if headroom >= burst else 1
             if positional:
                 # writes land at each row's own position: the window only
                 # needs to cover the highest live position after the burst
@@ -1321,6 +1448,8 @@ class ContinuousBatcher:
             exist, so None placeholders skip the gather."""
             if pc is None:
                 return
+            if self.brownout is not None and self.brownout.pause_prefix_harvest:
+                return  # browned out: admits stop paying the block copy-out
             C = self.prefill_chunk
             n_full = len(prompt_ids) // C
             if n_full <= skip_chunks:
@@ -1786,6 +1915,73 @@ class ContinuousBatcher:
                         waitlist.append(nxt)
                         self._wl_len = len(waitlist)
             drain_cancels(waitlist)
+            now = time.monotonic()
+            bo = self.brownout
+            if bo is not None:
+                # controller tick: queue depth as a fraction of the
+                # (configured, or nominal 4x-slots) limit, queue-age p95
+                # over the current waiters, HBM headroom via the
+                # registry-injected probe
+                depth = len(waitlist) + self._inbox.qsize()
+                limit = self.max_queue or 4 * self.max_slots
+                ages = sorted((now - r.t_enq) * 1e3 for r in waitlist)
+                age_p95 = ages[max(0, int(len(ages) * 0.95) - 1)] if ages else 0.0
+                headroom_frac = None
+                if self.hbm_headroom_fn is not None:
+                    try:
+                        headroom_frac = self.hbm_headroom_fn()
+                    except Exception:  # noqa: BLE001 — probe is best-effort
+                        headroom_frac = None
+                bo.update(depth_frac=depth / limit, age_p95_ms=age_p95,
+                          hbm_headroom_frac=headroom_frac, now=now)
+            # deadline sweep, queued side: waiters whose budget already ran
+            # out — or whose remaining budget the live rate EWMAs say cannot
+            # cover prefill plus the token floor — are shed BEFORE any
+            # prefill work, with a retryable envelope
+            if waitlist and any(r.deadline is not None for r in waitlist):
+                kept = []
+                for r in waitlist:
+                    left = None if r.deadline is None else r.deadline - now
+                    if left is None or (
+                        left > 0 and self._estimate_serve_s(r) <= left
+                    ):
+                        kept.append(r)
+                        continue
+                    waited_ms = (now - r.t_enq) * 1e3
+                    self.stats.record_shed("deadline", waited_ms=waited_ms)
+                    msg = (
+                        f"deadline infeasible (~{self._estimate_serve_s(r) * 1e3:.0f} ms "
+                        f"needed, {left * 1e3:.0f} ms left); skipped prefill; "
+                        if left > 0
+                        else f"deadline expired after {waited_ms:.0f} ms queued; "
+                    )
+                    try:
+                        r.emit("err", BatcherOverloaded(
+                            msg + "retry on another worker"
+                        ))
+                    except Exception:  # noqa: BLE001 — dead client loop
+                        pass
+                waitlist[:] = kept
+                self._wl_len = len(waitlist)
+            # deadline sweep, active side: a slot past its deadline is
+            # cooperatively aborted through the consumer-gone cancel path
+            # (freed at the next burst readback, cause-tagged "deadline")
+            for r in self._slots:
+                if (
+                    isinstance(r, _Request)
+                    and r.deadline is not None
+                    and not r.cancelled
+                    and now > r.deadline
+                ):
+                    r.deadline_hit = True
+                    r.cancelled = True
+                    try:
+                        r.emit("err", BatcherOverloaded(
+                            f"deadline exceeded mid-decode after {r.generated} "
+                            f"tokens; retry on another worker"
+                        ))
+                    except Exception:  # noqa: BLE001 — dead client loop
+                        pass
             self._wl_len = len(waitlist)
             # admit waiters: bursts of short same-bucket prompts go through
             # one batched dispatch; runs of LONG prompts go through one
@@ -1986,7 +2182,11 @@ class ContinuousBatcher:
                 ):
                     pump(0)
                 maybe_compact()
-                if spec is not None and 0 < len(active()) <= spec.max_active:
+                if (
+                    spec is not None
+                    and 0 < len(active()) <= spec.max_active
+                    and not (bo is not None and bo.pause_spec)
+                ):
                     # speculative regime (low occupancy = memory-bound):
                     # drain so proposals see full history and admit records
                     # have installed their n-gram indices, verify, drain
@@ -2026,6 +2226,7 @@ class ContinuousBatcher:
             self.stats.ttft_ms.record((now - req.t_enq) * 1e3)
             if req.t_admit:
                 self.stats.prefill_ms.record((now - req.t_admit) * 1e3)
+                self._note_prefill_rate(len(req.prompt_ids), now - req.t_admit)
             if req.trace is not None:
                 req.trace.mark("first_token", now)
         req.emit("tok", tok_id)

@@ -28,6 +28,7 @@ from ..store.manager import ModelStore, StoreError
 from ..utils.nuid import next_nuid
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
 from .batcher import BatcherOverloaded, BatcherStopped, ContinuousBatcher
+from .brownout import BrownoutConfig
 from .template import render_chat_template, stop_token_ids
 
 log = logging.getLogger(__name__)
@@ -88,6 +89,49 @@ def _spec_decode_env(default_k: int = 6) -> tuple[int, int]:
         except ValueError:
             log.warning("ignoring non-integer SPEC_DECODE_MAX_ACTIVE=%r", env)
     return k, max_active
+
+
+def _env_float(name: str, default: float) -> float:
+    env = os.environ.get(name, "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            log.warning("ignoring non-numeric %s=%r", name, env)
+    return default
+
+
+def _brownout_env(enabled: bool | None = None) -> BrownoutConfig | None:
+    """Adaptive-brownout config from the env (serve/brownout.py), or None
+    when disabled. ``BROWNOUT=0`` (or false/off) is the hard off-switch
+    (default on); the BROWNOUT_* threshold knobs tune the hysteresis."""
+    if enabled is None:
+        enabled = os.environ.get("BROWNOUT", "").strip().lower() not in (
+            "0", "false", "off",
+        )
+    if not enabled:
+        return None
+    return BrownoutConfig(
+        depth_hi=_env_float("BROWNOUT_DEPTH_HI", 0.75),
+        depth_lo=_env_float("BROWNOUT_DEPTH_LO", 0.40),
+        age_hi_ms=_env_float("BROWNOUT_AGE_HI_MS", 1500.0),
+        age_lo_ms=_env_float("BROWNOUT_AGE_LO_MS", 500.0),
+        hbm_lo_frac=_env_float("BROWNOUT_HBM_LO", 0.05),
+        dwell_s=_env_float("BROWNOUT_DWELL_S", 2.0),
+    )
+
+
+def _deadline_min_tokens_env(default: int = 1) -> int:
+    """Feasibility floor for deadline-aware admission: a request that cannot
+    deliver this many tokens before its deadline skips prefill and is shed
+    retryably (DEADLINE_MIN_TOKENS, default 1 = just the first token)."""
+    env = os.environ.get("DEADLINE_MIN_TOKENS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer DEADLINE_MIN_TOKENS=%r", env)
+    return default
 
 
 class JaxChatEngine(ChatEngine):
@@ -177,6 +221,10 @@ class JaxChatEngine(ChatEngine):
         # to the batcher so its owner thread stamps the admit/prefill/
         # first-token transitions on the same record
         trace = payload.pop("_trace", None)
+        # monotonic deadline injected by the worker from the client's
+        # X-Deadline-Ms header, capped by the per-op timeout ladder; popped
+        # for the same stays-verbatim reason as the trace
+        deadline = payload.pop("_deadline", None)
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
         stats = GenStats(prompt_tokens=len(prompt_ids))
@@ -189,7 +237,7 @@ class JaxChatEngine(ChatEngine):
             # message (the delta simply carries more text) — per-message
             # publish overhead is a real share of throughput at 64+ streams
             async for tok_batch in self.batcher.submit_batched(
-                prompt_ids, sp, info=end_info, trace=trace
+                prompt_ids, sp, info=end_info, trace=trace, deadline=deadline
             ):
                 if not toks:
                     stats.ttft_s = time.perf_counter() - t0
@@ -285,6 +333,8 @@ class LocalRegistry(Registry):
         restart_backoff_max_s: float = 30.0,
         max_restarts: int = 3,
         restart_window_s: float = 120.0,
+        brownout: bool | None = None,
+        deadline_min_tokens: int | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -313,6 +363,16 @@ class LocalRegistry(Registry):
             prefix_cache_blocks
             if prefix_cache_blocks is not None
             else _prefix_cache_blocks_env()
+        )
+        # adaptive brownout (serve/brownout.py) handed to every batcher;
+        # None reads BROWNOUT from the env (default on), the BROWNOUT_*
+        # threshold knobs tune the hysteresis. The HBM-headroom signal is
+        # this registry's admission accounting, injected as a probe.
+        self.brownout_cfg = _brownout_env(brownout)
+        self.deadline_min_tokens = (
+            deadline_min_tokens
+            if deadline_min_tokens is not None
+            else _deadline_min_tokens_env()
         )
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
@@ -604,6 +664,16 @@ class LocalRegistry(Registry):
         waits = [w for w in waits if w > 0]
         return min(waits) if waits else None
 
+    def _hbm_headroom_frac(self) -> float | None:
+        """Free fraction of the HBM admission budget (brownout signal),
+        or None when no budget is known. Called from batcher owner threads:
+        one dict sum under the GIL, no lock needed for a pressure signal."""
+        budget = _hbm_budget_bytes()
+        if not budget:
+            return None
+        committed = sum(self._hbm_committed.values())
+        return max(0.0, (budget - committed) / budget)
+
     def _load(self, model_id: str, paths: list[str]) -> JaxChatEngine:
         t0 = time.perf_counter()
         from ..gguf.reader import is_split_shard
@@ -647,6 +717,9 @@ class LocalRegistry(Registry):
             prefix_cache_blocks=self.prefix_cache_blocks,
             spec_decode_k=self.spec_decode_k,
             spec_max_active=self.spec_max_active,
+            brownout=self.brownout_cfg,
+            hbm_headroom_fn=self._hbm_headroom_frac,
+            deadline_min_tokens=self.deadline_min_tokens,
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time
@@ -736,6 +809,7 @@ class LocalRegistry(Registry):
                 "ready": bool(b.alive and not b._stopping),
                 "idle": bool(b.idle),
                 "heartbeat_age_s": round(b.heartbeat_age_s(), 3),
+                "brownout_level": int(getattr(b, "brownout_level", 0)),
             }
         return out
 

@@ -34,8 +34,8 @@ import time
 from ..config import WorkerConfig
 from ..obs import EVENTS, PromRenderer, Trace, new_trace_id
 from ..transport.client import Msg, NatsClient, connect
-from ..transport.envelope import envelope_error, envelope_ok
-from ..transport.protocol import TRACE_HEADER
+from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
+from ..transport.protocol import DEADLINE_HEADER, TRACE_HEADER
 from .api import EngineError, ModelNotFound, Registry
 
 log = logging.getLogger(__name__)
@@ -324,6 +324,17 @@ class Worker:
             return  # fire-and-forget stream request: nowhere to send tokens
         streaming = bool(payload.get("stream"))
         payload["_trace"] = trace  # engines pop it; fakes ignore it
+        if self.config.deadline_propagation:
+            # client budget (X-Deadline-Ms, wall ms) → monotonic deadline
+            # capped by the per-op ladder; the batcher sheds expired work at
+            # submit/admit and aborts mid-decode slots past it. An
+            # already-expired budget still flows through: the shed there is
+            # a retryable envelope, not a silent drop.
+            remaining = deadline_remaining_s((msg.headers or {}).get(DEADLINE_HEADER))
+            if remaining is not None:
+                payload["_deadline"] = time.monotonic() + min(
+                    remaining, self.config.chat_timeout_s
+                )
         try:
             async with _timeout(self.config.chat_timeout_s):
                 engine = await self.registry.get_engine(model_id)
@@ -553,6 +564,20 @@ class Worker:
             for cause, v in stats.shed_cause_counts().items():
                 r.counter("lmstudio_batcher_shed_by_cause_total", v,
                           labels={**labels, "cause": cause})
+            # deadline/brownout families — always present (zero-valued when
+            # quiet) so overload dashboards can alert on the first increment
+            causes = stats.shed_cause_counts()
+            r.counter("lmstudio_deadline_shed_total",
+                      causes.get("deadline", 0), labels=labels,
+                      help="requests shed because the client deadline "
+                           "expired or became infeasible before prefill")
+            r.counter("lmstudio_deadline_aborted_total",
+                      getattr(stats, "cancel_causes", {}).get("deadline", 0),
+                      labels=labels,
+                      help="mid-decode slots aborted past the client deadline")
+            r.gauge("lmstudio_brownout_level",
+                    getattr(eng.batcher, "brownout_level", 0), labels=labels,
+                    help="0=normal 1=brownout 2=shed-only")
             if hasattr(stats, "spec_counters"):
                 # speculative decoding: lmstudio_spec_{verifies,drafted,
                 # accepted}_total; the lmstudio_spec_accept_rate histogram

@@ -45,7 +45,7 @@ from ..obs import emit as obs_emit
 from ..utils import next_nuid
 from . import faults as _faults
 from . import protocol as p
-from .envelope import is_retryable_envelope
+from .envelope import deadline_header_value, is_retryable_envelope
 
 log = logging.getLogger(__name__)
 
@@ -643,6 +643,9 @@ class NatsClient:
         await self._ensure_resp_sub()
         headers = dict(headers) if headers else {}
         headers.setdefault(p.TRACE_HEADER, new_trace_id())
+        # absolute budget: the worker sheds/aborts work the caller has
+        # already abandoned (capped server-side by the per-op ladder)
+        headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
         token = next_nuid()
         inbox = f"{self._inbox_prefix}.{token}"
         fut: asyncio.Future[Msg] = asyncio.get_running_loop().create_future()
@@ -675,6 +678,7 @@ class NatsClient:
         logical request (with a fresh inbox) instead."""
         headers = dict(headers) if headers else {}
         headers.setdefault(p.TRACE_HEADER, new_trace_id())
+        headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
         inbox = self.new_inbox()
         sub = await self.subscribe(inbox)
         sub._fail_on_gap = True

@@ -9,6 +9,7 @@ marshal-failure reply (nats_llm_studio.go:211).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any
 
 FALLBACK = b'{"ok":false,"error":"internal serialization error"}'
@@ -42,6 +43,25 @@ def is_retryable_envelope(env: Any) -> bool:
     if env.get("retryable"):
         return True
     return error_is_retryable(str(env.get("error", "")))
+
+
+def deadline_header_value(timeout_s: float) -> str:
+    """Absolute wall-clock deadline (ms since the epoch) for
+    ``protocol.DEADLINE_HEADER``, derived from the caller's timeout."""
+    return str(int((time.time() + timeout_s) * 1000))
+
+
+def deadline_remaining_s(header_value: str | None) -> float | None:
+    """Seconds of client budget left for a ``DEADLINE_HEADER`` value
+    (negative once expired), or None when absent or unparseable — a garbled
+    header must never fail a request that would otherwise serve."""
+    if not header_value:
+        return None
+    try:
+        deadline_ms = int(header_value)
+    except (TypeError, ValueError):
+        return None
+    return deadline_ms / 1000.0 - time.time()
 
 
 def envelope_ok(data: Any = None, trace_id: str | None = None) -> bytes:

@@ -195,6 +195,12 @@ HDR_PREAMBLE = b"NATS/1.0\r\n"
 # request across every hop without touching the JSON payload
 TRACE_HEADER = "X-Trace-Id"
 
+# absolute client deadline in wall-clock milliseconds since the epoch:
+# stamped by request()/request_stream() from the caller's timeout, read by
+# the worker (capped by the per-op ladder) so the serving path can shed or
+# abort work whose caller has already given up
+DEADLINE_HEADER = "X-Deadline-Ms"
+
 
 def parse_headers(raw: bytes) -> dict[str, str]:
     headers: dict[str, str] = {}

@@ -1,0 +1,143 @@
+"""Direct unit tests for serve/template.py: the GGUF-embedded jinja path,
+the family fallbacks keyed off vocab markers, stop-token resolution, and the
+chat_model wiring through JaxChatEngine._encode_prompt — previously covered
+only indirectly through the serving e2e tests.
+"""
+
+import pytest
+
+from nats_llm_studio_tpu.gguf.constants import KEY_CHAT_TEMPLATE
+from nats_llm_studio_tpu.serve import template
+from nats_llm_studio_tpu.serve.template import (
+    render_chat_template,
+    stop_token_ids,
+)
+
+MESSAGES = [
+    {"role": "system", "content": "be brief"},
+    {"role": "user", "content": "hi"},
+]
+
+
+class StubTokenizer:
+    """Just the surface template.py and _encode_prompt touch: a vocab map,
+    an eos id, and encode()."""
+
+    def __init__(self, vocab: dict[str, int], eos_id: int | None = None):
+        self.vocab = vocab
+        self.eos_id = eos_id
+        self.encoded: list[str] = []
+
+    def encode(self, text: str) -> list[int]:
+        self.encoded.append(text)
+        return list(range(len(text.split())))
+
+
+# -- jinja path ---------------------------------------------------------------
+
+
+@pytest.mark.skipif(template._JINJA is None, reason="jinja2 not installed")
+def test_jinja_template_renders_with_special_tokens():
+    md = {
+        KEY_CHAT_TEMPLATE: (
+            "{{ bos_token }}{% for m in messages %}"
+            "[{{ m.role }}]{{ m.content }}{{ eos_token }}{% endfor %}"
+            "{% if add_generation_prompt %}[assistant]{% endif %}"
+        ),
+        "tokenizer.ggml.tokens": ["<s>", "</s>"],
+        "tokenizer.ggml.bos_token_id": 0,
+        "tokenizer.ggml.eos_token_id": 1,
+    }
+    out = render_chat_template(md, MESSAGES)
+    assert out == "<s>[system]be brief</s>[user]hi</s>[assistant]"
+    # add_generation_prompt=False drops the trailing assistant cue
+    out = render_chat_template(md, MESSAGES, add_generation_prompt=False)
+    assert out.endswith("[user]hi</s>")
+
+
+def test_broken_jinja_template_falls_back():
+    """A malformed embedded template must never fail the chat — the
+    vocab-marker fallback serves instead (here: chatml)."""
+    md = {
+        KEY_CHAT_TEMPLATE: "{% for m in messages %}{{ unclosed",
+        "tokenizer.ggml.tokens": ["<|im_start|>", "<|im_end|>"],
+    }
+    out = render_chat_template(md, MESSAGES)
+    assert out.startswith("<|im_start|>system\nbe brief<|im_end|>\n")
+    assert out.endswith("<|im_start|>assistant\n")
+
+
+# -- family fallbacks keyed off vocab markers --------------------------------
+
+
+def test_llama3_fallback_format():
+    md = {"tokenizer.ggml.tokens": ["<|start_header_id|>", "<|eot_id|>"]}
+    out = render_chat_template(md, MESSAGES)
+    assert out.startswith("<|begin_of_text|>")
+    assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>" in out
+    assert "<|start_header_id|>user<|end_header_id|>\n\nhi<|eot_id|>" in out
+    assert out.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+
+def test_granite_fallback_format():
+    md = {"tokenizer.ggml.tokens": ["<|start_of_role|>", "<|end_of_role|>"]}
+    out = render_chat_template(md, MESSAGES)
+    assert "<|start_of_role|>user<|end_of_role|>hi<|end_of_text|>\n" in out
+    assert out.endswith("<|start_of_role|>assistant<|end_of_role|>")
+
+
+def test_chatml_fallback_and_generic_default():
+    md = {"tokenizer.ggml.tokens": ["<|im_start|>"]}
+    out = render_chat_template(md, MESSAGES)
+    assert "<|im_start|>user\nhi<|im_end|>\n" in out
+    # no markers and no template at all: plain role-prefixed lines
+    out = render_chat_template({}, MESSAGES)
+    assert out == "system: be brief\nuser: hi\nassistant:"
+    # missing role/content default to user/empty instead of raising
+    out = render_chat_template({}, [{}], add_generation_prompt=False)
+    assert out == "user: \n"
+
+
+def test_llama3_marker_wins_over_later_families():
+    """Dispatch precedence is llama3 > granite > chatml when a vocab
+    carries several marker sets."""
+    md = {"tokenizer.ggml.tokens": [
+        "<|start_header_id|>", "<|start_of_role|>", "<|im_start|>",
+    ]}
+    assert render_chat_template(md, MESSAGES).startswith("<|begin_of_text|>")
+
+
+# -- stop tokens --------------------------------------------------------------
+
+
+def test_stop_token_ids_collects_eos_and_vocab_markers():
+    tok = StubTokenizer(
+        vocab={"<|eot_id|>": 7, "</s>": 3, "hello": 11}, eos_id=2
+    )
+    ids = stop_token_ids(tok)
+    assert ids == frozenset({2, 3, 7})  # eos + known markers, never "hello"
+    # no eos, empty vocab: empty set rather than an error
+    assert stop_token_ids(StubTokenizer(vocab={})) == frozenset()
+
+
+# -- chat_model wiring (serve/registry.py) -----------------------------------
+
+
+def test_engine_encode_prompt_renders_template_then_encodes():
+    """JaxChatEngine._encode_prompt — the path every chat_model request
+    takes — must feed the RENDERED template to the tokenizer, and the
+    engine's stop ids must come from the same vocab."""
+    from nats_llm_studio_tpu.serve.registry import JaxChatEngine
+
+    tok = StubTokenizer(vocab={"<|eot_id|>": 9}, eos_id=9)
+    eng = JaxChatEngine(
+        "acme/tpl", batcher=None, tokenizer=tok, cfg=None,
+        meta={"tokenizer.ggml.tokens": ["<|start_header_id|>"]},
+    )
+    ids = eng._encode_prompt({"messages": MESSAGES})
+    assert len(tok.encoded) == 1
+    prompt = tok.encoded[0]
+    assert prompt.startswith("<|begin_of_text|>")
+    assert prompt.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    assert ids == tok.encode(prompt)  # encoder output passed through verbatim
+    assert eng._sampling({}).stop_ids == frozenset({9})
