@@ -1368,6 +1368,124 @@ def spec_decode_bench(cfg, params, model_id: str, *, seq: int | None = None,
 
 
 # ---------------------------------------------------------------------------
+# tensor-parallel serving: the SAME engine at tp=1 vs tp=N across the mesh
+# ---------------------------------------------------------------------------
+
+
+def tensor_parallel_bench(cfg, params, model_id: str, *, seq: int | None = None,
+                          slots: int | None = None, n_reqs: int | None = None,
+                          max_new: int | None = None) -> dict:
+    """Serving through ``lmstudio.chat_model`` at tp=1 vs tp=N (N = every
+    local device, downshifted until the model's head layout divides):
+    per-replica served tok/s, batcher decode step_ms p50, and TTFT p50 for
+    the same closed wave. tp=N runs ONE replica across N chips — its
+    per-replica number is the whole mesh's; ``tok_s_per_chip`` is the
+    honest efficiency divisor. Skipped (with a reason) on one device."""
+    import asyncio
+
+    from nats_llm_studio_tpu.parallel import build_mesh
+    from nats_llm_studio_tpu.parallel.sharding import (
+        kv_replicated, shard_params, validate_mesh_for_config,
+    )
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": "single device — no tp axis to bench"}
+    tokenizer = _make_bench_tokenizer(cfg)
+    seq = seq or int(os.environ.get("BENCH_TP_SEQ", "512"))
+    slots = slots or int(os.environ.get("BENCH_TP_SLOTS", "8"))
+    n_reqs = n_reqs or int(os.environ.get("BENCH_TP_REQS", "16"))
+    max_new = max_new or int(os.environ.get("BENCH_TP_NEW", "64"))
+
+    def servable(tp: int) -> bool:
+        try:
+            validate_mesh_for_config(
+                build_mesh(f"tp={tp}", devices=devices[:tp]), cfg)
+            return True
+        except ValueError:
+            return False
+
+    tp_n = int(os.environ.get("BENCH_TP_N", "0")) or len(devices)
+    while tp_n > 1 and not servable(tp_n):
+        tp_n //= 2  # e.g. 4 heads on 8 forced host devices -> tp=4
+    if tp_n < 2:
+        return {"skipped": f"no tp>1 layout divides heads={cfg.n_heads}/"
+                           f"{cfg.n_kv_heads} on {len(devices)} devices"}
+
+    def run_mode(tp: int) -> dict:
+        mesh = build_mesh(f"tp={tp}", devices=devices[:tp]) if tp > 1 else None
+        p = shard_params(params, mesh, cfg) if mesh is not None else params
+        batcher = ContinuousBatcher(
+            p, cfg, max_slots=slots, max_seq_len=seq,
+            buckets=[b for b in (64, 256) if b < seq] + [seq], mesh=mesh,
+        )
+
+        async def body(nc, one_chat):
+            # warm the singleton admit, the group widths the wave can
+            # coalesce into, and the decode windows it sweeps — compiles
+            # must not land inside the measured wall
+            await one_chat(900, SHORT_PROMPT, 8)
+            w = 2
+            while w <= min(batcher.max_group_admit, n_reqs, slots):
+                await asyncio.gather(
+                    *(one_chat(900 + 10 * w + i, SHORT_PROMPT, 8)
+                      for i in range(w))
+                )
+                w *= 2
+            await one_chat(990, SHORT_PROMPT, max_new)
+            await asyncio.sleep(0.5)  # drain in-flight zombie bursts
+            s0 = batcher.stats.snapshot()
+            h0 = _phase_hists(batcher)
+            t0 = time.perf_counter()
+            reqs = await asyncio.gather(
+                *(one_chat(1000 + i, f"{SHORT_PROMPT} [{i}]", max_new)
+                  for i in range(n_reqs))
+            )
+            wall = time.perf_counter() - t0
+            phase = _phase_delta(batcher, s0, h0)
+            ttfts = sorted(r["ttft_s"] * 1e3 for r in reqs
+                           if r["ttft_s"] == r["ttft_s"])
+            toks = sum(r["completion_tokens"] for r in reqs)
+            tok_s = round(toks / wall, 1)
+            out = {
+                "tp": tp,
+                "chips_per_replica": tp,
+                "tok_s_per_replica": tok_s,  # one replica serves the wave
+                "tok_s_per_chip": round(tok_s / tp, 1),
+                "ttft_p50_ms": round(_pctl(ttfts, 0.5), 1),
+                "step_ms_p50": phase.get("batcher_decode_step_p50_ms", 0.0),
+                "requests": n_reqs,
+                "max_tokens": max_new,
+                "parse_failures": sum(1 for r in reqs if r["parse_fail"]),
+                "batcher_phase": phase,
+            }
+            if mesh is not None and kv_replicated(mesh, cfg):
+                out["kv_replicated"] = True  # GQA fallback path measured
+            return out
+
+        out = _drive_engine(cfg, params if mesh is None else p, model_id,
+                            tokenizer, batcher, body)
+        del p
+        gc.collect()
+        return out
+
+    on = run_mode(tp_n)
+    off = run_mode(1)
+    return {
+        "devices": len(devices),
+        "max_seq_len": seq,
+        "slots": slots,
+        f"tp{tp_n}": on,
+        "tp1": off,
+        "per_replica_speedup": (
+            round(on["tok_s_per_replica"] / off["tok_s_per_replica"], 2)
+            if off.get("tok_s_per_replica") else 0.0
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 
 
 def chaos_bench() -> dict:
@@ -1603,6 +1721,15 @@ def main() -> None:
                 cfg, params, "bench/tiny",
                 seq=256, n_reqs=2, max_new=24, spec_k=4,
             ))
+        if os.environ.get("BENCH_TP", "1") != "0":
+            # micro-run of the tensor-parallel phase: meaningful under
+            # forced host devices (XLA_FLAGS=--xla_force_host_platform_
+            # device_count=8), reports skipped on one device
+            _run_phase(tiny_detail, "tensor_parallel",
+                       lambda: tensor_parallel_bench(
+                           cfg, params, "bench/tiny",
+                           seq=128, slots=4, n_reqs=4, max_new=16,
+                       ))
         if os.environ.get("BENCH_CHAOS", "1") != "0":
             # fault-injected serving: recovery must hold in CI smoke too
             _run_phase(tiny_detail, "chaos", chaos_bench)
@@ -1696,6 +1823,13 @@ def main() -> None:
     # -- speculative decoding: prompt-lookup drafts, ON vs OFF ---------------
     if os.environ.get("BENCH_SPEC", "1") != "0":
         _run_phase(detail, "spec_decode", lambda: spec_decode_bench(
+            cfg, params, "bench/llama3-8b"
+        ))
+        gc.collect()
+
+    # -- tensor-parallel serving: tp=1 vs tp=N on the same engine ------------
+    if os.environ.get("BENCH_TP", "1") != "0":
+        _run_phase(detail, "tensor_parallel", lambda: tensor_parallel_bench(
             cfg, params, "bench/llama3-8b"
         ))
         gc.collect()

@@ -36,7 +36,23 @@ class WorkerConfig:
     bucket: str = field(default_factory=lambda: _env("MODEL_BUCKET", "llm-models"))
 
     # TPU build additions
-    mesh_shape: str = field(default_factory=lambda: _env("TPU_MESH", ""))  # e.g. "tp=8" or "dp=2,tp=4"
+    # serving mesh spec (parallel.mesh.serving_mesh): "auto" (default)
+    # shards every local device on the tp axis — tensor-parallel serving
+    # is the multi-device default; a single-device host serves unsharded.
+    # "off"/"none"/"1" force tp=1; explicit specs like "tp=4" or
+    # "dp=2,tp=4" build exactly that mesh. MESH_SHAPE is the documented
+    # knob; TPU_MESH is honored as the legacy alias.
+    mesh_shape: str = field(
+        default_factory=lambda: _env("MESH_SHAPE", "") or _env("TPU_MESH", "auto")
+    )
+    # opt-in persistent XLA compilation cache (ROADMAP item 5, first
+    # bite): a restarted worker (or an autoscaled replica on identical
+    # hardware) replays compiles from disk instead of paying the
+    # multi-second jit grid again. Empty = off. Applied by
+    # ``configure_jax()`` at startup, before the first compile.
+    compile_cache_dir: str = field(
+        default_factory=lambda: _env("JAX_COMPILE_CACHE_DIR", "")
+    )
     max_batch_slots: int = field(default_factory=lambda: int(_env("MAX_BATCH_SLOTS", "8")))
     max_seq_len: int = field(default_factory=lambda: int(_env("MAX_SEQ_LEN", "4096")))
     # "none" (serve in cfg dtype) or "int8" (weight-only per-channel int8:
@@ -162,6 +178,24 @@ class WorkerConfig:
             self.prefix_cache_blocks = 0
         if _env("SPEC_DECODE", "").strip().lower() in ("0", "false", "off"):
             self.spec_decode_k = 0
+
+    def configure_jax(self) -> None:
+        """Apply process-wide JAX settings. Must run before the first
+        compile (main.py calls it ahead of mesh construction); idempotent,
+        and a no-op when no knob is set — library users who never call it
+        lose nothing but the compile cache."""
+        if not self.compile_cache_dir:
+            return
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", self.compile_cache_dir)
+        try:
+            # the serving grid is many sub-second programs (per-bucket
+            # prefills, per-window chunks); cache all of them, not just
+            # the slow ones, so a supervisor bounce replays the whole grid
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except AttributeError:  # older jax: keep the directory, lose the knob
+            pass
 
     # timeout ladder — mirrors the reference's per-op deadlines
     # (nats_llm_studio.go:229, :251, :289, :328)

@@ -11,9 +11,10 @@ fact"). This CLI is that wiring, made first-class:
     python -m nats_llm_studio_tpu chat <model_id> "prompt..."
 
 Env contract (reference README.md:489-494, minus the LM Studio URL):
-NATS_URL, LMSTUDIO_MODELS_DIR, NATS_QUEUE_GROUP, plus TPU_MESH,
-MAX_BATCH_SLOTS, MAX_SEQ_LEN. Multi-host meshes initialize through
-``jax.distributed`` when JAX_COORDINATOR_ADDRESS is set.
+NATS_URL, LMSTUDIO_MODELS_DIR, NATS_QUEUE_GROUP, plus MESH_SHAPE (legacy
+alias TPU_MESH; default "auto" = all local devices on tp),
+JAX_COMPILE_CACHE_DIR, MAX_BATCH_SLOTS, MAX_SEQ_LEN. Multi-host meshes
+initialize through ``jax.distributed`` when JAX_COORDINATOR_ADDRESS is set.
 """
 
 from __future__ import annotations
@@ -56,6 +57,9 @@ async def _run_serve(args: argparse.Namespace) -> None:
     from .transport.jetstream import ObjectStore
 
     cfg = WorkerConfig()
+    # process-wide JAX knobs (persistent compile cache) must land before
+    # the first compile — i.e. before mesh build and any engine load
+    cfg.configure_jax()
     # deterministic chaos harness (transport/faults.py): only active when
     # CHAOS_SPEC is set — zero-cost otherwise
     plan = faults.plan_from_env()
@@ -69,12 +73,13 @@ async def _run_serve(args: argparse.Namespace) -> None:
         log.info("embedded broker on %s", broker.url)
 
     _maybe_init_distributed()
-    mesh = None
-    if cfg.mesh_shape:
-        from .parallel import build_mesh
+    from .parallel import serving_mesh
 
-        mesh = build_mesh(cfg.mesh_shape)
+    mesh = serving_mesh(cfg.mesh_shape)
+    if mesh is not None:
         log.info("mesh: %s", dict(mesh.shape))
+    else:
+        log.info("mesh: none (single device or MESH_SHAPE=off)")
 
     nc = await connect(cfg.nats_url, name="store-client")
     schemes = tuple(s for s in cfg.url_pull_schemes.split(",") if s)

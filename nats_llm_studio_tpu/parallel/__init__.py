@@ -15,6 +15,7 @@ from .mesh import (
     AXIS_TP,
     build_mesh,
     parse_mesh_spec,
+    serving_mesh,
 )
 from .sharding import param_sharding_rules, shard_cache, shard_params
 
@@ -26,6 +27,7 @@ __all__ = [
     "AXIS_SP",
     "build_mesh",
     "parse_mesh_spec",
+    "serving_mesh",
     "param_sharding_rules",
     "shard_params",
     "shard_cache",

@@ -54,7 +54,7 @@ def load_params_sharded(
     dt = jnp.dtype(dtype or cfg.dtype)
     if quant not in ("none", "int8"):
         raise ValueError(f"unknown quant mode {quant!r}")
-    rules = param_sharding_rules(mesh)
+    rules = param_sharding_rules(mesh, cfg)
 
     def t(name: str) -> np.ndarray:
         return reader.tensor(name).to_numpy()

@@ -81,6 +81,10 @@ def estimate_device_bytes(
     ep = mesh_shape.get("ep", 1)
     dp = mesh_shape.get("dp", 1)
     seq = seq_len or cfg.max_seq_len
+    # replicated-KV GQA fallback (sharding.kv_replicated): when tp cannot
+    # divide the KV heads, wk/wv/bk/bv and the cache stay whole per chip
+    kv_tp = tp if cfg.n_kv_heads % tp == 0 else 1
+    _KV_LEAVES = ("blocks.wk", "blocks.wv", "blocks.bk", "blocks.bv")
 
     params = 0
     for name, leaf in _leaves(cfg, dtype_bytes).items():
@@ -89,7 +93,8 @@ def estimate_device_bytes(
             n *= dim
         # divide by the mesh factor on each sharded axis. For experts the
         # first sharded axis is ep, the second tp; for dense leaves it is tp.
-        factors = [ep, tp] if len(leaf.shard_axes) == 2 else [tp] * len(leaf.shard_axes)
+        t = kv_tp if name in _KV_LEAVES else tp
+        factors = [ep, tp] if len(leaf.shard_axes) == 2 else [t] * len(leaf.shard_axes)
         for f in factors:
             n //= f
         if quant == "int8" and leaf.quantizable:
@@ -102,7 +107,7 @@ def estimate_device_bytes(
 
     cb = cache_dtype_bytes or dtype_bytes
     kv = 2 * cfg.n_layers * batch * seq * cfg.n_kv_heads * cfg.head_dim * cb
-    kv //= dp * tp  # batch on dp, kv heads on tp
+    kv //= dp * kv_tp  # batch on dp, kv heads on tp (unless replicated)
 
     # workspace: logits [B, V] f32 (vocab sharded on tp) + activations
     # [B, T, d]-scale temporaries + collective buffers; a conservative pad

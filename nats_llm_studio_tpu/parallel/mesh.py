@@ -58,3 +58,31 @@ def build_mesh(spec: str | dict[str, int] = "", devices=None) -> Mesh:
         raise ValueError(f"mesh {axes} needs {n} devices, have {len(devices)}")
     arr = mesh_utils.create_device_mesh(tuple(axes.values()), devices=devices)
     return Mesh(arr, tuple(axes.keys()))
+
+
+# spellings that force unsharded (tp=1) serving regardless of device count
+_MESH_OFF = ("off", "none", "0", "1", "tp=1")
+
+
+def serving_mesh(spec: str = "auto", devices=None) -> Mesh | None:
+    """The serving path's mesh (env knob ``MESH_SHAPE``). Empty/``auto``
+    puts every local device on tp — tensor-parallel serving is the
+    multi-device default — and returns None on a single-device host, where
+    the unsharded code path *is* tp=1 and a one-device Mesh would only add
+    partitioner overhead. ``off``/``none``/``1``/``tp=1`` force unsharded
+    serving on any host; explicit specs ("tp=4", "dp=2,tp=4") build
+    exactly that mesh on the first axis-product devices (so "tp=2" on an
+    8-chip host serves on 2 chips instead of erroring)."""
+    s = (spec or "").strip().lower()
+    if s in _MESH_OFF:
+        return None
+    devices = list(devices if devices is not None else jax.devices())
+    if s in ("", "auto"):
+        return None if len(devices) == 1 else build_mesh("", devices=devices)
+    axes = parse_mesh_spec(spec)
+    n = 1
+    for v in axes.values():
+        n *= v
+    # an oversized spec keeps the full list so build_mesh raises its clear
+    # "needs N devices, have M" error
+    return build_mesh(axes, devices=devices[:n] if n <= len(devices) else devices)

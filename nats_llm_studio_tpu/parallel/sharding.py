@@ -28,13 +28,30 @@ def _axis(mesh: Mesh, name: str) -> str | None:
     return name if name in mesh.axis_names and mesh.shape[name] > 1 else None
 
 
-def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
+def kv_replicated(mesh: Mesh, cfg: ModelConfig) -> bool:
+    """True when the GQA replicated-KV fallback is active: tp exceeds the
+    KV head count (so the cache heads axis cannot shard) but still divides
+    the query heads — wq/wo and the FFN shard normally while wk/wv and the
+    KV cache stay replicated. Small KV trees make this a good trade: a
+    Llama-3-8B's 8 KV heads on a tp=16 pod replicate ~1/9 of the weight
+    bytes to keep 16-way sharding on the other 8/9."""
+    tp = mesh.shape.get(AXIS_TP, 1)
+    return tp > 1 and cfg.n_kv_heads % tp != 0 and tp > cfg.n_kv_heads \
+        and cfg.n_heads % tp == 0
+
+
+def param_sharding_rules(mesh: Mesh, cfg: ModelConfig | None = None) -> dict[str, P]:
     """PartitionSpec per params-pytree key (blocks.* keys are the stacked
     per-layer weights). The leading [L] stack axis shards on pp (pipeline
-    stages own contiguous layer slices — parallel/pipeline.py)."""
+    stages own contiguous layer slices — parallel/pipeline.py).
+
+    With ``cfg``, GQA models whose KV head count tp cannot divide get the
+    replicated-KV fallback (``kv_replicated``): wk/wv/bk/bv stay whole per
+    chip so the KV cache's heads axis can too."""
     tp = _axis(mesh, AXIS_TP)
     ep = _axis(mesh, AXIS_EP)
     pp = _axis(mesh, AXIS_PP)
+    kv = None if cfg is not None and kv_replicated(mesh, cfg) else tp
     return {
         "embed": P(None, None),  # replicated: read once per token, cheap
         "out_norm": P(None),
@@ -42,12 +59,12 @@ def param_sharding_rules(mesh: Mesh) -> dict[str, P]:
         "blocks.attn_norm": P(pp, None),
         "blocks.ffn_norm": P(pp, None),
         "blocks.wq": P(pp, None, tp),
-        "blocks.wk": P(pp, None, tp),
-        "blocks.wv": P(pp, None, tp),
+        "blocks.wk": P(pp, None, kv),
+        "blocks.wv": P(pp, None, kv),
         "blocks.wo": P(pp, tp, None),
         "blocks.bq": P(pp, tp),  # qwen2 QKV biases: output-feature sharded
-        "blocks.bk": P(pp, tp),
-        "blocks.bv": P(pp, tp),
+        "blocks.bk": P(pp, kv),
+        "blocks.bv": P(pp, kv),
         "blocks.w_gate": P(pp, None, tp),
         "blocks.w_up": P(pp, None, tp),
         "blocks.w_down": P(pp, tp, None),
@@ -78,13 +95,15 @@ def _flatten_keys(params: dict[str, Any], prefix: str = "") -> dict[str, Any]:
     return out
 
 
-def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+def shard_params(params: dict[str, Any], mesh: Mesh,
+                 cfg: ModelConfig | None = None) -> dict[str, Any]:
     """device_put every leaf with its rule (replicated if no rule matches).
 
     For giant checkpoints prefer loading shard-by-shard (store/loader);
-    this helper is for params already materialized on host.
+    this helper is for params already materialized on host. Pass ``cfg``
+    to honor the replicated-KV GQA fallback (``kv_replicated``).
     """
-    rules = param_sharding_rules(mesh)
+    rules = param_sharding_rules(mesh, cfg)
 
     def place(path: str, leaf):
         spec = rules.get(path, P())
@@ -105,20 +124,40 @@ def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
     return walk(params)
 
 
-def cache_spec(mesh: Mesh) -> P:
+def cache_spec(mesh: Mesh, cfg: ModelConfig | None = None) -> P:
     """KV cache [B, L, Hkv, S, D]: batch on dp, layers on pp, heads on tp,
     sequence on sp (the ring-attention axis — long prompts' cache memory
-    scales down with the sp degree; SURVEY.md §5 long-context)."""
+    scales down with the sp degree; SURVEY.md §5 long-context). With
+    ``cfg``, the heads axis drops tp under the replicated-KV GQA fallback
+    (``kv_replicated``) — the cache must mirror wk/wv's sharding or every
+    write would be a resharding collective."""
+    tp = _axis(mesh, AXIS_TP)
+    if cfg is not None and kv_replicated(mesh, cfg):
+        tp = None
     return P(
-        _axis(mesh, AXIS_DP), _axis(mesh, AXIS_PP), _axis(mesh, AXIS_TP),
+        _axis(mesh, AXIS_DP), _axis(mesh, AXIS_PP), tp,
         _axis(mesh, AXIS_SP), None,
     )
 
 
-def shard_cache(k_cache, v_cache, mesh: Mesh):
+def row_cache_spec(mesh: Mesh, cfg: ModelConfig | None = None) -> P:
+    """Transient prefill row caches and prefix-cache blocks
+    [m, L, Hkv, S', D]: heads on tp only. The batch axis is often 1 and S'
+    a prompt bucket, so dp/sp cannot apply; pp never serves the dense
+    path. Same KV-head rule as ``cache_spec`` so block copy-ins between a
+    row cache and the serving ring never reshard."""
+    tp = _axis(mesh, AXIS_TP)
+    if cfg is not None and kv_replicated(mesh, cfg):
+        tp = None
+    return P(None, None, tp, None, None)
+
+
+def shard_cache(k_cache, v_cache, mesh: Mesh, cfg: ModelConfig | None = None,
+                spec: P | None = None):
     from ..ops.kvcache import KVQ, is_quantized
 
-    spec = cache_spec(mesh)
+    if spec is None:
+        spec = cache_spec(mesh, cfg)
     sh = NamedSharding(mesh, spec)
     # quantized caches: codes take the full cache spec, scales drop the
     # trailing head_dim axis
@@ -154,12 +193,23 @@ def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig,
         )
     tp = mesh.shape.get(AXIS_TP, 1)
     ep = mesh.shape.get(AXIS_EP, 1)
-    if cfg.n_kv_heads % tp and tp > 1:
-        raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
     if cfg.n_heads % tp and tp > 1:
-        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+        raise ValueError(
+            f"unservable on this mesh: n_heads={cfg.n_heads} not divisible "
+            f"by tp={tp}"
+        )
+    if cfg.n_kv_heads % tp and tp > 1 and not kv_replicated(mesh, cfg):
+        # tp > n_kv_heads with tp | n_heads is served via the replicated-KV
+        # fallback (kv_replicated); anything else has no clean layout
+        raise ValueError(
+            f"unservable on this mesh: n_kv_heads={cfg.n_kv_heads} not "
+            f"divisible by tp={tp} (replicated-KV fallback needs "
+            f"tp > n_kv_heads and tp | n_heads={cfg.n_heads})"
+        )
     if cfg.d_ff % tp and tp > 1:
-        raise ValueError(f"d_ff={cfg.d_ff} not divisible by tp={tp}")
+        raise ValueError(
+            f"unservable on this mesh: d_ff={cfg.d_ff} not divisible by tp={tp}"
+        )
     if cfg.is_moe and ep > 1 and cfg.n_experts % ep:
         raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")
     sp = mesh.shape.get(AXIS_SP, 1)

@@ -38,7 +38,14 @@ from ..engine.sampling import sample_rows, spec_accept_rows
 from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
 from ..transport import faults as _faults
-from ..ops.kvcache import kv_copy_slice, kv_gather_block, kv_roll_s, kv_slice
+from ..ops.kvcache import (
+    KVQ,
+    is_quantized,
+    kv_copy_slice,
+    kv_gather_block,
+    kv_roll_s,
+    kv_slice,
+)
 from .brownout import SHED_ONLY, BrownoutConfig, BrownoutController
 from .prefix_cache import PrefixCache
 from .spec import SpecConfig, SpecSlot, make_slot
@@ -361,6 +368,50 @@ class ContinuousBatcher:
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
 
+        # -- explicit cache shardings (tensor-parallel serving) --------------
+        # With a mesh, the serving K/V ring arrives in every jit already
+        # sharded (heads on tp — shard_cache in _run), but values *created
+        # inside* a jit (the fused admits' fresh row caches) and the cache
+        # write boundaries would otherwise be left to the partitioner's
+        # guess — worst case a replicated transient per chip plus an
+        # all-gather at the serving-cache write. ``pin_cache``/``pin_row``
+        # pin the KV head axis to tp at creation and at every read/write
+        # boundary; the constraint matches the donated inputs' shardings
+        # exactly, so buffer donation survives. Both are identity with no
+        # mesh — the tp=1 path compiles byte-for-byte unchanged.
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.sharding import (
+                cache_spec,
+                row_cache_spec,
+                validate_mesh_for_config,
+            )
+
+            validate_mesh_for_config(mesh, cfg)
+            cache_sh = NamedSharding(mesh, cache_spec(mesh, cfg))
+            row_sh = NamedSharding(mesh, row_cache_spec(mesh, cfg))
+
+            def _pin_with(c, sh):
+                if is_quantized(c):
+                    s_sh = NamedSharding(mesh, PartitionSpec(*list(sh.spec)[:-1]))
+                    return KVQ(
+                        q=jax.lax.with_sharding_constraint(c.q, sh),
+                        s=jax.lax.with_sharding_constraint(c.s, s_sh),
+                    )
+                return jax.lax.with_sharding_constraint(c, sh)
+
+            def pin_cache(c):
+                return _pin_with(c, cache_sh)
+
+            def pin_row(c):
+                return _pin_with(c, row_sh)
+        else:
+
+            def pin_cache(c):
+                return c
+
+            pin_row = pin_cache
+
         @partial(jax.jit, static_argnums=(6,))
         def prefill1(params, tokens, k1, v1, start, last_pos, window):
             # lm_head at one position only ([1,1,vocab]); non-final chunks
@@ -373,10 +424,11 @@ class ContinuousBatcher:
             # single-dispatch kernel from the O(T^2) full-window reads
             # (and KVQ dequant transients) this removes.
             logits, k1, v1 = fwd(
-                params, tokens=tokens, k_cache=k1, v_cache=v1, start_pos=start,
+                params, tokens=tokens, k_cache=pin_row(k1), v_cache=pin_row(v1),
+                start_pos=start,
                 logit_positions=last_pos, uniform_start=True, attn_window=window,
             )
-            return logits, k1, v1
+            return logits, pin_row(k1), pin_row(v1)
 
         def _insert_and_sample(params, K, V, tok, k1, v1, logits, slot, shift,
                                seed, temp, topk, topp):
@@ -392,8 +444,8 @@ class ContinuousBatcher:
             zero = jnp.zeros((), jnp.int32)
             k1 = kv_roll_s(k1, shift, s_axis=3)
             v1 = kv_roll_s(v1, shift, s_axis=3)
-            K = kv_copy_slice(K, k1, (slot, zero, zero, zero, zero))
-            V = kv_copy_slice(V, v1, (slot, zero, zero, zero, zero))
+            K = pin_cache(kv_copy_slice(K, k1, (slot, zero, zero, zero, zero)))
+            V = pin_cache(kv_copy_slice(V, v1, (slot, zero, zero, zero, zero)))
             first = sample_rows(
                 logits[:, 0], seed[None], jnp.zeros((1,), jnp.int32),
                 temp[None], topk[None], topp[None],
@@ -412,6 +464,7 @@ class ContinuousBatcher:
             from ..models.llama import make_cache as _mk
 
             k1, v1 = _mk(cfg, 1, self.max_seq)
+            k1, v1 = pin_row(k1), pin_row(v1)
             # logit_positions: lm_head at the prompt end only — skips
             # bucket× the lm_head FLOPs and the [1, bucket, vocab] f32
             logits, k1, v1 = fwd(
@@ -443,6 +496,7 @@ class ContinuousBatcher:
 
             m, bucket = tokens.shape
             km, vm = _mk(cfg, m, bucket)
+            km, vm = pin_row(km), pin_row(vm)
             logits, km, vm = fwd(
                 params, tokens=tokens, k_cache=km, v_cache=vm,
                 start_pos=jnp.zeros((m,), jnp.int32),
@@ -472,7 +526,7 @@ class ContinuousBatcher:
             (K, V, tok), _ = jax.lax.scan(
                 body, (K, V, tok), jnp.arange(m, dtype=jnp.int32)
             )
-            return firsts, K, V, tok
+            return firsts, pin_cache(K), pin_cache(V), tok
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
         def finish_admit(params, K, V, tok, k1, v1, logits, slot, shift,
@@ -493,7 +547,7 @@ class ContinuousBatcher:
             zero = jnp.zeros((), jnp.int32)
             k1 = kv_copy_slice(k1, kb, (zero, zero, zero, start, zero))
             v1 = kv_copy_slice(v1, vb, (zero, zero, zero, start, zero))
-            return k1, v1
+            return pin_row(k1), pin_row(v1)
 
         @jax.jit
         def prefill_full(params, tokens, k1, v1, n):
@@ -507,12 +561,12 @@ class ContinuousBatcher:
             see; the rolled-in junk above ``n`` lands on future ring slots
             that decode overwrites before they can become valid)."""
             logits, k1, v1 = fwd(
-                params, tokens=tokens, k_cache=k1, v_cache=v1,
+                params, tokens=tokens, k_cache=pin_row(k1), v_cache=pin_row(v1),
                 start_pos=jnp.zeros((1,), jnp.int32),
                 logit_positions=jnp.reshape(n - 1, (1,)),
                 fresh_prefill=True,
             )
-            return logits, k1, v1
+            return logits, pin_row(k1), pin_row(v1)
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(6,))
         def prefill_chunk_group(params, tokens, km, vm, start, last_pos, window):
@@ -522,10 +576,11 @@ class ContinuousBatcher:
             ``window`` (static, bucketed >= start + C) bounds reads to the
             live prefix — see prefill1."""
             logits, km, vm = fwd(
-                params, tokens=tokens, k_cache=km, v_cache=vm, start_pos=start,
+                params, tokens=tokens, k_cache=pin_row(km), v_cache=pin_row(vm),
+                start_pos=start,
                 logit_positions=last_pos, uniform_start=True, attn_window=window,
             )
-            return logits, km, vm
+            return logits, pin_row(km), pin_row(vm)
 
         @jax.jit
         def select_end(final, logits, is_end):
@@ -567,7 +622,7 @@ class ContinuousBatcher:
             (K, V, tok), _ = jax.lax.scan(
                 body, (K, V, tok), jnp.arange(m, dtype=jnp.int32)
             )
-            return firsts, K, V, tok
+            return firsts, pin_cache(K), pin_cache(V), tok
 
         max_seq = self.max_seq
 
@@ -577,7 +632,10 @@ class ContinuousBatcher:
             a fresh head below max_seq again — the wrapped ring's recovery
             path (VERDICT r2 weak #7: without this, one wrap costs windowed
             attention reads for the rest of the worker's life)."""
-            return kv_roll_s(K, shift, s_axis=3), kv_roll_s(V, shift, s_axis=3)
+            return (
+                pin_cache(kv_roll_s(K, shift, s_axis=3)),
+                pin_cache(kv_roll_s(V, shift, s_axis=3)),
+            )
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11, 12))
         def decode(params, tok, K, V, pos, ring, seeds, steps, temp, topk, topp,
@@ -606,10 +664,10 @@ class ContinuousBatcher:
                 return (nxt, K, V), nxt
 
             (tok, K, V), toks = jax.lax.scan(
-                body, (tok, K, V), jnp.arange(n, dtype=jnp.int32)
+                body, (tok, pin_cache(K), pin_cache(V)), jnp.arange(n, dtype=jnp.int32)
             )
             # [B, n] tokens, caches, device-side carries
-            return toks.T, K, V, tok, pos + n, steps + n
+            return toks.T, pin_cache(K), pin_cache(V), tok, pos + n, steps + n
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(10, 11))
         def decode_pos(params, tok, K, V, pos, seeds, steps, temp, topk, topp,
@@ -630,9 +688,9 @@ class ContinuousBatcher:
                 return (nxt, K, V), nxt
 
             (tok, K, V), toks = jax.lax.scan(
-                body, (tok, K, V), jnp.arange(n, dtype=jnp.int32)
+                body, (tok, pin_cache(K), pin_cache(V)), jnp.arange(n, dtype=jnp.int32)
             )
-            return toks.T, K, V, tok, pos + n, steps + n
+            return toks.T, pin_cache(K), pin_cache(V), tok, pos + n, steps + n
 
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(12,))
         def spec_verify(params, tok, K, V, pos, drafts, dlen, seeds, steps,
@@ -648,9 +706,10 @@ class ContinuousBatcher:
             this row's own future writes — no rollback)."""
             toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B,k+1]
             logits, K, V = fwd(
-                params, tokens=toks_in, k_cache=K, v_cache=V,
+                params, tokens=toks_in, k_cache=pin_cache(K), v_cache=pin_cache(V),
                 start_pos=pos, attn_window=window,
             )
+            K, V = pin_cache(K), pin_cache(V)
             out, n_emit = spec_accept_rows(
                 logits, drafts, dlen, seeds, steps, temp, topk, topp
             )
@@ -850,7 +909,7 @@ class ContinuousBatcher:
         n = 0
         for m in widths:
             if m == 1:
-                k1, v1 = make_cache(self.cfg, 1, self.max_seq)
+                k1, v1 = self._make_row_cache(1, self.max_seq)
                 for w in wins:
                     logits, k1, v1 = self._prefill1(
                         self.params, jnp.zeros((1, C), jnp.int32), k1, v1,
@@ -875,7 +934,7 @@ class ContinuousBatcher:
                         )
                         n += 1
             else:
-                km, vm = make_cache(self.cfg, m, self.max_seq)
+                km, vm = self._make_row_cache(m, self.max_seq)
                 for w in wins:
                     logits, km, vm = self._prefill_chunk_group(
                         self.params, jnp.zeros((m, C), jnp.int32), km, vm,
@@ -892,6 +951,35 @@ class ContinuousBatcher:
         releases them. Returns the number of blocks evicted."""
         pc = self.prefix_cache
         return pc.resize(0) if pc is not None else 0
+
+    def _make_row_cache(self, batch: int, seq_len: int):
+        """Fresh transient prefill cache, committed with the row sharding
+        when a mesh is live (heads on tp — parallel.sharding.row_cache_spec)
+        so the prefill jits compile against per-chip heads instead of
+        inferring replication from an unsharded host array."""
+        k, v = make_cache(self.cfg, batch, seq_len)
+        if self.mesh is not None:
+            from ..parallel.sharding import row_cache_spec, shard_cache
+
+            k, v = shard_cache(
+                k, v, self.mesh, spec=row_cache_spec(self.mesh, self.cfg)
+            )
+        return k, v
+
+    def _shard_block(self, kb, vb):
+        """Commit a gathered prefix-cache block pair to the row sharding
+        (heads on tp). ``kv_gather_block`` slices eagerly; on a tp-only
+        mesh the slice usually inherits the head sharding, but a dp/sp
+        mesh's slice can land gathered on one device — the device_put
+        makes per-chip residency deterministic, so a later hit's copy-in
+        never pays an all-gather."""
+        if self.mesh is None:
+            return kb, vb
+        from ..parallel.sharding import row_cache_spec, shard_cache
+
+        return shard_cache(
+            kb, vb, self.mesh, spec=row_cache_spec(self.mesh, self.cfg)
+        )
 
     # -- client API ----------------------------------------------------------
 
@@ -1088,7 +1176,7 @@ class ContinuousBatcher:
         if self.mesh is not None:
             from ..parallel.sharding import shard_cache
 
-            K, V = shard_cache(K, V, self.mesh)
+            K, V = shard_cache(K, V, self.mesh, cfg=cfg)
         # device-resident next-token carry: burst k+1's input comes straight
         # from burst k's output ON DEVICE, so the host can dispatch k+1
         # before reading k's tokens back (the depth-2 pipeline below) — the
@@ -1456,7 +1544,7 @@ class ContinuousBatcher:
                 return
             blocks: list = [None] * skip_chunks
             for j in range(skip_chunks, n_full):
-                blocks.append((
+                blocks.append(self._shard_block(
                     kv_gather_block(kc, row, j * C, C),
                     kv_gather_block(vc, row, j * C, C),
                 ))
@@ -1519,7 +1607,7 @@ class ContinuousBatcher:
                 # [1, 1, vocab] materializes; with the cache on, every
                 # full chunk's END row is kept too — that row is what makes
                 # a future full-prefix hit sampleable.
-                k1, v1 = make_cache(cfg, 1, self.max_seq)
+                k1, v1 = self._make_row_cache(1, self.max_seq)
                 n_full = n // C
                 chunk_logits = [None] * n_full if pc is not None else None
                 hit = pc.match(req.prompt_ids) if pc is not None else None
@@ -1751,7 +1839,7 @@ class ContinuousBatcher:
                     r.sp.seed if r.sp.seed is not None else random.getrandbits(31)
                     for r in reqs
                 ]
-                km, vm = make_cache(cfg, mpad, self.max_seq)
+                km, vm = self._make_row_cache(mpad, self.max_seq)
                 final = jnp.zeros((mpad, 1, cfg.vocab_size), jnp.float32)
                 n_chunks = -(-max(ns) // C)
                 end_chunk = [(ns[i] - 1) // C for i in idx]
@@ -1857,7 +1945,7 @@ class ContinuousBatcher:
             if self.mesh is not None:
                 from ..parallel.sharding import shard_cache
 
-                K, V = shard_cache(K, V, self.mesh)
+                K, V = shard_cache(K, V, self.mesh, cfg=cfg)
             tok_dev = jnp.zeros((B,), jnp.int32)
 
         coalesce_s = self.admit_coalesce_ms / 1e3

@@ -65,16 +65,20 @@ def serving_chunk(max_seq: int, prefill_chunk: int = 256) -> int:
     return chunk
 
 
-def prefix_block_bytes(cfg, chunk: int, kv_quant: str | None = None) -> int:
-    """Worst-case device bytes of ONE cached entry: the K+V block pair for
-    ``chunk`` positions plus the optional chunk-end logits row. Used by the
-    registry's HBM admission to commit the cache's budget up front."""
+def prefix_block_bytes(cfg, chunk: int, kv_quant: str | None = None,
+                       tp: int = 1) -> int:
+    """Worst-case PER-DEVICE bytes of ONE cached entry: the K+V block pair
+    for ``chunk`` positions plus the optional chunk-end logits row. Used by
+    the registry's HBM admission to commit the cache's budget up front.
+    ``tp`` is the tensor-parallel factor actually sharding the block's head
+    axis (1 under the replicated-KV GQA fallback) — blocks live split
+    across the mesh, so each chip holds 1/tp of the KV bytes."""
     quant = (kv_quant if kv_quant is not None else cfg.kv_quant) == "int8"
     dtype_bytes = 4 if cfg.dtype == "float32" else 2
     per_pos = (
         cfg.head_dim * (1 if quant else dtype_bytes) + (4 if quant else 0)
     )
-    kv = 2 * cfg.n_layers * cfg.n_kv_heads * chunk * per_pos
+    kv = 2 * cfg.n_layers * cfg.n_kv_heads * chunk * per_pos // max(1, tp)
     return kv + 4 * cfg.vocab_size  # + [1, 1, vocab] f32 end-logits
 
 

@@ -429,12 +429,22 @@ class LocalRegistry(Registry):
 
     async def pull(self, identifier: str) -> str:
         try:
-            _, transcript = await self.store.pull(identifier)
+            path, transcript = await self.store.pull(identifier)
         except StoreError as e:
             raise EngineError(str(e)) from None
         # a fresh pull is the other operator reset path for a poisoned model
         self._poisoned.pop(identifier, None)
         self._crash_times.pop(identifier, None)
+        # mesh gate at pull time: a model whose head layout this worker's
+        # mesh cannot shard is reported unservable NOW, in a retryable
+        # cause-tagged envelope, instead of crashing the first chat_model.
+        # The file stays cached — a mesh reconfig makes it servable later.
+        reason = await asyncio.to_thread(self._mesh_unservable, str(path))
+        if reason is not None:
+            raise EngineError(
+                f"pulled {identifier}, but it is {reason} — retry on "
+                f"another worker"
+            )
         return transcript
 
     async def delete(self, model_id: str) -> str:
@@ -592,6 +602,41 @@ class LocalRegistry(Registry):
             seq_len=seq, cache_dtype_bytes=1 if self.kv_quant == "int8" else None,
         )["total"]
 
+    def _mesh_unservable(self, path: str) -> str | None:
+        """Reason this worker's mesh cannot serve the GGUF at ``path``
+        (the validate_mesh_for_config message), or None when servable or
+        the check cannot run. Best-effort: a failure to *check* is not a
+        failure to *serve* — _load retells any real problem."""
+        if self.mesh is None:
+            return None
+        from pathlib import Path
+
+        from ..gguf.reader import is_split_shard
+
+        p = Path(path)
+        paths = sorted(str(f) for f in p.glob("*.gguf")) if p.is_dir() else [str(p)]
+        if not paths:
+            return None
+        split = sorted(q for q in paths if is_split_shard(q))
+        try:
+            with open_gguf(split[0] if split else paths[0]) as reader:
+                cfg = ModelConfig.from_gguf_metadata(reader.metadata)
+            validate_mesh_for_config(self.mesh, cfg)
+        except ValueError as e:
+            return str(e)
+        except Exception:  # noqa: BLE001 — gate is best-effort
+            return None
+        return None
+
+    def _kv_tp(self, cfg: ModelConfig) -> int:
+        """The tp factor actually applied to KV rings and prefix blocks:
+        the mesh's tp when it divides the KV heads, else 1 (the
+        replicated-KV GQA fallback keeps whole KV per chip)."""
+        if self.mesh is None:
+            return 1
+        tp = dict(self.mesh.shape).get("tp", 1)
+        return tp if tp > 1 and cfg.n_kv_heads % tp == 0 else 1
+
     def _shrink_prefix_caches(self, exclude: str | None = None) -> bool:
         """Reclaim HBM by dropping the least-recently-used engine's prefix
         cache — no unload, serving state untouched; blocks pinned by an
@@ -632,7 +677,7 @@ class LocalRegistry(Registry):
         seq = min(self.max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
         chunk = serving_chunk(seq)
         return self.prefix_cache_blocks * prefix_block_bytes(
-            cfg, chunk, kv_quant=self.kv_quant
+            cfg, chunk, kv_quant=self.kv_quant, tp=self._kv_tp(cfg)
         )
 
     def _pick_idle_victim(self) -> str | None:
@@ -799,6 +844,7 @@ class LocalRegistry(Registry):
         (owner thread running, no crash), ``ready`` (alive and accepting
         submits), ``heartbeat_age_s`` (staleness; only meaningful when the
         batcher is not idle — an idle owner blocks on its inbox)."""
+        mesh_shape = dict(self.mesh.shape) if self.mesh is not None else {}
         out: dict[str, dict[str, Any]] = {}
         for mid, eng in self._engines.items():
             b = eng.batcher
@@ -811,6 +857,8 @@ class LocalRegistry(Registry):
                 "heartbeat_age_s": round(b.heartbeat_age_s(), 3),
                 "brownout_level": int(getattr(b, "brownout_level", 0)),
             }
+            if mesh_shape:
+                out[mid]["mesh"] = mesh_shape
         return out
 
     def poisoned_models(self) -> dict[str, str]:
@@ -827,6 +875,8 @@ class LocalRegistry(Registry):
             "backend": jax.default_backend(),
             "hbm_committed_bytes": sum(self._hbm_committed.values()),
         }
+        if self.mesh is not None:
+            out["mesh"] = dict(self.mesh.shape)
         if self.engine_restarts_total:
             out["engine_restarts"] = self.engine_restarts_total
         if self._poisoned:

@@ -529,6 +529,10 @@ class Worker:
             v = reg.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 r.gauge(f"lmstudio_registry_{key}", v)
+        mesh = reg.get("mesh") or {}
+        r.gauge("lmstudio_mesh_tp", int(mesh.get("tp", 1)),
+                help="tensor-parallel width of the serving mesh "
+                     "(1 = unsharded serving)")
         r.gauge("lmstudio_events_emitted_total", EVENTS.emitted)
         # fault-tolerance families — ALWAYS present (zero-valued when
         # nothing has failed) so dashboards and the chaos tests can assert
