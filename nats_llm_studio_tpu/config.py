@@ -90,6 +90,24 @@ class WorkerConfig:
     prefix_cache_blocks: int = field(
         default_factory=lambda: int(_env("PREFIX_CACHE_BLOCKS", "64"))
     )
+    # paged KV (serve/block_pool.py): ONE refcounted fixed-size-block pool
+    # shared by live slots, the prefix cache, and spec decode, addressed
+    # through per-slot block tables. Default on; KV_PAGED=0/false/off
+    # restores the pre-paged contiguous per-slot rings (the bit-equivalence
+    # baseline). KV_BLOCK_TOKENS is tokens per block (snapped down to
+    # divide the prefill chunk); KV_POOL_BLOCKS=0 auto-sizes for zero
+    # starvation (every slot at max_seq + the prefix budget) — deployments
+    # under-provision it to pack more slots into the same HBM.
+    kv_paged: bool = field(
+        default_factory=lambda: _env("KV_PAGED", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    kv_block_tokens: int = field(
+        default_factory=lambda: int(_env("KV_BLOCK_TOKENS", "16"))
+    )
+    kv_pool_blocks: int = field(
+        default_factory=lambda: int(_env("KV_POOL_BLOCKS", "0"))
+    )
     # speculative decoding (serve/spec.py): max prompt-lookup draft tokens
     # per slot per verify dispatch. SPEC_DECODE=0 is the hard off-switch
     # (wins over SPEC_DECODE_K); SPEC_DECODE_K=0 also disables. NOTE: k > 0

@@ -92,6 +92,8 @@ async def _run_serve(args: argparse.Namespace) -> None:
         prefix_cache_blocks=cfg.prefix_cache_blocks,
         spec_decode_k=cfg.spec_decode_k, spec_max_active=cfg.spec_max_active,
         brownout=cfg.brownout,
+        kv_paged=cfg.kv_paged, kv_block_tokens=cfg.kv_block_tokens,
+        kv_pool_blocks=cfg.kv_pool_blocks,
         restart_backoff_s=cfg.engine_restart_backoff_s,
         restart_backoff_max_s=cfg.engine_restart_backoff_max_s,
         max_restarts=cfg.engine_max_restarts,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.flash_attention import (
+    chunk_block_multiple,
     flash_attention_auto,
     flash_attention_chunk_auto,
     flash_attention_chunk_kvq_auto,
@@ -214,7 +215,7 @@ def _attention_block(
                 # raises at trace time mid-serving (an odd max_seq like
                 # 4600 is accepted by the batcher but only the dense path
                 # can serve it)
-                mult = 32 if quantized else (8 if jnp.dtype(dt).itemsize >= 4 else 16)
+                mult = chunk_block_multiple(quantized, jnp.dtype(dt).itemsize)
                 bk = 512
                 while win % bk and bk > mult:
                     bk //= 2

@@ -5,6 +5,7 @@ trace.py, events.py, prom.py. Import-light on purpose: nothing here may
 import jax or the transport (both import *us*).
 """
 
+from .compile_cache import compile_cache_counts, install_compile_cache_listener
 from .events import EVENTS, EventRing, emit
 from .histogram import HistSnapshot, LogHistogram
 from .prom import PromRenderer
@@ -14,6 +15,8 @@ __all__ = [
     "EVENTS",
     "EventRing",
     "emit",
+    "compile_cache_counts",
+    "install_compile_cache_listener",
     "HistSnapshot",
     "LogHistogram",
     "PromRenderer",

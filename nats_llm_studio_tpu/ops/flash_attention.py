@@ -28,6 +28,17 @@ DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
+def chunk_block_multiple(quantized: bool, itemsize: int = 2) -> int:
+    """Sublane multiple Mosaic requires of any cache-window extent the chunk
+    kernels tile over: int8 codes need 32 rows, f32 8, bf16/f16 16. Both the
+    chunk-continuation gate in models/llama.py and the paged-KV block-size
+    clamp in serve/batcher.py use this floor, so a pool block is always a
+    whole number of kernel tiles."""
+    if quantized:
+        return 32
+    return 8 if itemsize >= 4 else 16
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale: float, block_q: int, block_k: int

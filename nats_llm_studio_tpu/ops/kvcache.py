@@ -141,3 +141,129 @@ def kv_roll_s(cache, shift, s_axis: int):
         q=jnp.roll(cache.q, shift, axis=s_axis),
         s=jnp.roll(cache.s, shift, axis=s_axis),
     )
+
+
+# -- paged block pool ---------------------------------------------------------
+#
+# The pool layout is [NB, L, Hkv, T, D] (codes) / [NB, L, Hkv, T] (scales):
+# one leading axis of fixed-size blocks of T positions, shared by every live
+# slot, the prefix cache, and spec decode.  A slot's logical [B, L, Hkv, S, D]
+# cache is the gather of its block table along the leading axis; after a
+# decode burst only the touched blocks are scattered back.  Block id 0 is the
+# null block (junk pad) — reads from it are masked by the causal mask and
+# writes to it are discarded state, so duplicates of id 0 in a scatter are
+# benign even though jnp scatter leaves duplicate-index order undefined.
+
+
+def kv_pool_zeros(shape, dtype=None, quant: bool = False):
+    """A zeroed pool leaf-set: bf16/f32 array or KVQ pair, [NB, L, H, T, D]."""
+    if quant:
+        return kv_zeros(shape)
+    return jnp.zeros(shape, dtype if dtype is not None else jnp.bfloat16)
+
+
+def _pool_take(a, tbl):
+    """Gather [B, nb] block ids into a contiguous per-row view.
+
+    a: [NB, L, H, T, ...] pool leaf;  tbl: [B, nb] int32 block ids
+    returns [B, L, H, nb*T, ...] — the S axis is the concatenation of the
+    row's blocks in table order.
+    """
+    b, nb = tbl.shape
+    v = jnp.take(a, tbl.reshape(-1), axis=0).reshape((b, nb) + a.shape[1:])
+    v = jnp.moveaxis(v, 1, 3)  # [B, L, H, nb, T, ...]
+    return v.reshape(v.shape[:3] + (nb * a.shape[3],) + a.shape[4:])
+
+
+def kv_pool_gather_view(pool, tbl):
+    """Materialize the [B, L, H, nb*T, D] cache view a block table describes
+    (per leaf on KVQ).  The view feeds the existing positional ``forward``
+    path unchanged: its S extent IS the attention window."""
+    if not is_quantized(pool):
+        return _pool_take(pool, tbl)
+    return KVQ(q=_pool_take(pool.q, tbl), s=_pool_take(pool.s, tbl))
+
+
+def _pool_blocks_of_view(v, n_blocks, block_tokens):
+    """[B, L, H, nb*T, ...] -> [B, nb, L, H, T, ...] (split S into blocks)."""
+    blk = v.reshape(v.shape[:3] + (n_blocks, block_tokens) + v.shape[4:])
+    return jnp.moveaxis(blk, 3, 1)
+
+
+def kv_pool_scatter_view(pool, view, tbl, vb):
+    """Write back the touched blocks of a gathered view.
+
+    vb: [B, NTB] indices INTO THE VIEW's block axis (clipped to [0, nb));
+    the pool block ids come from ``take_along_axis(tbl, vb)``.  Rows never
+    share writable blocks (CoW guarantees it), so the only duplicate ids in
+    the flattened scatter are null-block pads — benign junk writes.
+    """
+    b, nb = tbl.shape
+    bids = jnp.take_along_axis(tbl, vb, axis=1).reshape(-1)  # [B*NTB]
+
+    def scat(p, v):
+        t = p.shape[3]
+        blk = _pool_blocks_of_view(v, nb, t)  # [B, nb, L, H, T, ...]
+        idx = vb.reshape(vb.shape + (1,) * (blk.ndim - 2))
+        touched = jnp.take_along_axis(blk, idx, axis=1)  # [B, NTB, L, H, T, ...]
+        return p.at[bids].set(touched.reshape((-1,) + touched.shape[2:]))
+
+    if not is_quantized(pool):
+        return scat(pool, view)
+    return KVQ(q=scat(pool.q, view.q), s=scat(pool.s, view.s))
+
+
+def kv_pool_write_row(pool, row, bids):
+    """Write one prefilled row cache into the pool's blocks ``bids``.
+
+    row: [1, L, H, S', D] (already quantized under KVQ); bids: [nblk] int32.
+    S' < T writes a partial leading block via DUS; otherwise S' must be a
+    multiple of T and every block scatters in one op.  Pad bids with 0 (the
+    null block) when the row has fewer real blocks than ``len(bids)``.
+    """
+
+    def put(p, r):
+        t = p.shape[3]
+        s = r.shape[3]
+        if s <= t:
+            start = (bids[0],) + (jnp.int32(0),) * (p.ndim - 1)
+            return jax.lax.dynamic_update_slice(p, r.astype(p.dtype), start)
+        if s % t:
+            raise ValueError(f"row length {s} not a multiple of block size {t}")
+        blk = r[0].reshape(r.shape[1:3] + (s // t, t) + r.shape[4:])
+        blk = jnp.moveaxis(blk, 2, 0)  # [nblk, L, H, T, ...]
+        return p.at[bids].set(blk.astype(p.dtype))
+
+    if not is_quantized(pool):
+        return put(pool, row)
+    return KVQ(q=put(pool.q, row.q), s=put(pool.s, row.s))
+
+
+def kv_pool_copy_block(pool, dst, src):
+    """Copy-on-write: duplicate block ``src`` into ``dst`` (traced scalars)."""
+
+    def cp(p):
+        sizes = (1,) + p.shape[1:]
+        zeros = (jnp.int32(0),) * (p.ndim - 1)
+        blk = jax.lax.dynamic_slice(p, (src,) + zeros, sizes)
+        return jax.lax.dynamic_update_slice(p, blk, (dst,) + zeros)
+
+    if not is_quantized(pool):
+        return cp(pool)
+    return KVQ(q=cp(pool.q), s=cp(pool.s))
+
+
+def kv_pool_read_blocks(pool, bids):
+    """Gather ``bids`` [nblk] into a [1, L, H, nblk*T, D] row-cache-shaped
+    chunk (per leaf on KVQ) — the partial-prefix-hit path uses this to seed
+    a transient row cache from cached pool blocks."""
+
+    def rd(a):
+        v = jnp.take(a, bids, axis=0)  # [nblk, L, H, T, ...]
+        v = jnp.moveaxis(v, 0, 2)  # [L, H, nblk, T, ...]
+        v = v.reshape(v.shape[:2] + (v.shape[2] * v.shape[3],) + v.shape[4:])
+        return v[None]  # [1, L, H, nblk*T, ...]
+
+    if not is_quantized(pool):
+        return rd(pool)
+    return KVQ(q=rd(pool.q), s=rd(pool.s))

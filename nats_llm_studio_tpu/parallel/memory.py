@@ -114,3 +114,18 @@ def estimate_device_bytes(
     work = batch * cfg.vocab_size * 4 // tp + 64 * 2**20
     total = params + kv + work
     return {"params": params, "kv_cache": kv, "workspace": work, "total": total}
+
+
+def kv_pool_block_bytes(cfg: ModelConfig, block_tokens: int,
+                        kv_quant: str | None = None, tp: int = 1) -> int:
+    """Per-device bytes of ONE paged-KV pool block: K+V for ``block_tokens``
+    positions across every layer. Under int8 KVQ the codes are 1 byte/elem
+    plus one f32 scale per (layer, kv-head, position). ``tp`` is the factor
+    actually sharding the KV-head axis (1 under the replicated-KV GQA
+    fallback) — the registry prices the whole pool as blocks x this."""
+    quant = (kv_quant if kv_quant is not None else cfg.kv_quant) == "int8"
+    dtype_bytes = 4 if cfg.dtype == "float32" else 2
+    per_pos = (
+        cfg.head_dim * (1 if quant else dtype_bytes) + (4 if quant else 0)
+    )
+    return 2 * cfg.n_layers * cfg.n_kv_heads * block_tokens * per_pos // max(1, tp)

@@ -152,6 +152,16 @@ def row_cache_spec(mesh: Mesh, cfg: ModelConfig | None = None) -> P:
     return P(None, None, tp, None, None)
 
 
+def pool_spec(mesh: Mesh, cfg: ModelConfig | None = None) -> P:
+    """The paged KV block pool [NB, L, Hkv, T, D]: KV heads on tp, every
+    other axis replicated. The block axis stays unsharded — block ids are
+    global, so a gather of any slot's table lands on the device that owns
+    the same head shard, and pool<->view moves never reshard. Same
+    replicated-KV fallback rule as ``cache_spec``; the axis layout matches
+    ``row_cache_spec`` (heads at index 2) by construction."""
+    return row_cache_spec(mesh, cfg)
+
+
 def shard_cache(k_cache, v_cache, mesh: Mesh, cfg: ModelConfig | None = None,
                 spec: P | None = None):
     from ..ops.kvcache import KVQ, is_quantized

@@ -83,25 +83,37 @@ def prefix_block_bytes(cfg, chunk: int, kv_quant: str | None = None,
 
 
 class _Node:
-    """One chunk edge: the KV block for tokens [depth*C, (depth+1)*C)."""
+    """One chunk edge: the KV block for tokens [depth*C, (depth+1)*C).
+
+    In paged mode the node owns no arrays: ``payload`` is an opaque handle
+    (the batcher passes ``(pool_epoch, [block ids])``), ``units`` is how
+    many pool blocks it pins, and ``free_fn`` (the pool decref) runs when
+    the node is truly freed — i.e. the same deferred point at which the
+    legacy mode nulls its arrays, so eviction-under-pin stays safe."""
 
     __slots__ = ("key", "parent", "children", "kb", "vb", "logits", "refs",
-                 "tick", "dead", "nbytes")
+                 "tick", "dead", "nbytes", "payload", "units", "free_fn")
 
-    def __init__(self, key, parent, kb, vb, logits):
+    def __init__(self, key, parent, kb, vb, logits, payload=None,
+                 units=1, nbytes=None, free_fn=None):
         self.key = key
         self.parent = parent
         self.children: dict[tuple, _Node] = {}
         self.kb = kb
         self.vb = vb
         self.logits = logits
+        self.payload = payload
+        self.units = units
+        self.free_fn = free_fn
         self.refs = 0
         self.tick = 0
         self.dead = False
-        self.nbytes = kv_nbytes(kb) + kv_nbytes(vb)
+        self.nbytes = nbytes if nbytes is not None else kv_nbytes(kb) + kv_nbytes(vb)
 
     def free(self) -> None:
-        self.kb = self.vb = self.logits = None
+        if self.free_fn is not None and self.payload is not None:
+            self.free_fn(self.payload)
+        self.kb = self.vb = self.logits = self.payload = None
 
 
 @dataclass
@@ -117,6 +129,11 @@ class PrefixHit:
         return [(nd.kb, nd.vb) for nd in self.nodes]
 
     @property
+    def payloads(self) -> list:
+        """Per-node opaque payloads (paged mode: (epoch, block ids))."""
+        return [nd.payload for nd in self.nodes]
+
+    @property
     def end_logits(self):
         """Chunk-end logits of the deepest matched node (None unless the
         harvesting prefill computed them)."""
@@ -124,13 +141,32 @@ class PrefixHit:
 
 
 class PrefixCache:
-    """Radix (chunk-trie) cache of prefilled KV blocks with LRU eviction."""
+    """Radix (chunk-trie) cache of prefilled KV blocks with LRU eviction.
 
-    def __init__(self, chunk: int, capacity_blocks: int):
+    Two ownership modes share one tree:
+
+    * legacy (default): each node owns a materialized ``[1, L, Hkv, C, D]``
+      block pair; capacity counts nodes.
+    * paged (``acquire_fn``/``free_fn`` given): nodes hold pool block-id
+      payloads. ``acquire_fn(payload)`` runs when a node is created (the
+      batcher increfs the pool) and ``free_fn(payload)`` when it is freed
+      (decref), so harvest is a refcount bump and eviction a decref — no
+      KV bytes move. Capacity, ``inserted_blocks`` and ``evicted_blocks``
+      are denominated in POOL BLOCKS (``node_blocks`` per node).
+    """
+
+    def __init__(self, chunk: int, capacity_blocks: int, *,
+                 node_blocks: int = 1, node_bytes: int | None = None,
+                 acquire_fn=None, free_fn=None):
         if chunk <= 0:
             raise ValueError("chunk must be positive")
         self.chunk = chunk
         self.capacity = max(0, capacity_blocks)
+        self.node_blocks = max(1, node_blocks)
+        self.node_bytes = node_bytes
+        self.acquire_fn = acquire_fn
+        self.free_fn = free_fn
+        self.paged = free_fn is not None
         self._root: dict[tuple, _Node] = {}
         self._lock = threading.Lock()
         self._tick = 0
@@ -213,12 +249,13 @@ class PrefixCache:
     def insert(self, token_ids, blocks, logits_list=None) -> int:
         """Insert the prompt's full-chunk blocks along one tree path.
 
-        ``blocks[j]`` is the (k, v) block pair for chunk j, or None when the
-        caller skipped materializing it (the chunk was just matched, so its
-        node already exists). ``logits_list[j]`` is the chunk-end logits row
-        or None; existing nodes missing logits are backfilled, which is how
-        a flash-harvested path later earns full-hit capability. Returns the
-        number of NEW blocks inserted."""
+        ``blocks[j]`` is the (k, v) block pair for chunk j — or, in paged
+        mode, the opaque payload handed back to acquire_fn/free_fn — or None
+        when the caller skipped materializing it (the chunk was just
+        matched, so its node already exists). ``logits_list[j]`` is the
+        chunk-end logits row or None; existing nodes missing logits are
+        backfilled, which is how a flash-harvested path later earns
+        full-hit capability. Returns the number of NEW nodes inserted."""
         if self.capacity <= 0:
             return 0
         chunks = self._chunks(token_ids)
@@ -232,13 +269,22 @@ class PrefixCache:
                 if nd is None:
                     if j >= len(blocks) or blocks[j] is None:
                         break  # nothing to create this node from
-                    kb, vb = blocks[j]
                     lg = logits_list[j] if logits_list else None
-                    nd = _Node(key, parent, kb, vb, lg)
+                    if self.paged:
+                        payload = blocks[j]
+                        if self.acquire_fn is not None:
+                            self.acquire_fn(payload)
+                        nd = _Node(key, parent, None, None, lg,
+                                   payload=payload, units=self.node_blocks,
+                                   nbytes=self.node_bytes or 0,
+                                   free_fn=self.free_fn)
+                    else:
+                        kb, vb = blocks[j]
+                        nd = _Node(key, parent, kb, vb, lg)
                     level[key] = nd
-                    self._blocks += 1
+                    self._blocks += nd.units
                     self._bytes += nd.nbytes
-                    self.inserted_blocks += 1
+                    self.inserted_blocks += nd.units
                     added += 1
                 elif nd.logits is None and logits_list and j < len(logits_list):
                     nd.logits = logits_list[j]
@@ -258,26 +304,53 @@ class PrefixCache:
         arbitrarily deep chains."""
         evicted = 0
         while self._blocks > capacity:
-            leaf = None
-            stack = list(self._root.values())
-            while stack:
-                nd = stack.pop()
-                if nd.children:
-                    stack.extend(nd.children.values())
-                elif leaf is None or nd.tick < leaf.tick:
-                    leaf = nd
+            leaf = self._lru_leaf_locked()
             if leaf is None:
                 break
-            owner = leaf.parent.children if leaf.parent is not None else self._root
-            owner.pop(leaf.key, None)
-            self._blocks -= 1
-            self._bytes -= leaf.nbytes
-            self.evicted_blocks += 1
-            evicted += 1
-            leaf.dead = True
-            if leaf.refs <= 0:
-                leaf.free()
+            evicted += self._detach_locked(leaf)
         return evicted
+
+    def _lru_leaf_locked(self, unpinned_only: bool = False):
+        leaf = None
+        stack = list(self._root.values())
+        while stack:
+            nd = stack.pop()
+            if nd.children:
+                stack.extend(nd.children.values())
+            elif unpinned_only and nd.refs > 0:
+                continue
+            elif leaf is None or nd.tick < leaf.tick:
+                leaf = nd
+        return leaf
+
+    def _detach_locked(self, leaf) -> int:
+        owner = leaf.parent.children if leaf.parent is not None else self._root
+        owner.pop(leaf.key, None)
+        self._blocks -= leaf.units
+        self._bytes -= leaf.nbytes
+        self.evicted_blocks += leaf.units
+        leaf.dead = True
+        if leaf.refs <= 0:
+            leaf.free()
+        return leaf.units
+
+    def reclaim(self, n_units: int) -> int:
+        """Evict UNPINNED LRU leaves until ~``n_units`` capacity units have
+        actually been freed (paged mode: pool blocks returned to the free
+        list right now, not deferred behind a pin). The batcher calls this
+        when the pool runs dry — cached prefixes are the reclaimable tier,
+        live slots are not. Returns units freed."""
+        freed = 0
+        with self._lock:
+            while freed < n_units:
+                leaf = self._lru_leaf_locked(unpinned_only=True)
+                if leaf is None:
+                    break
+                freed += self._detach_locked(leaf)
+        if freed:
+            obs_emit("prefix_evict", blocks=freed, resident=self.blocks,
+                     reclaim=True)
+        return freed
 
     def resize(self, capacity_blocks: int) -> int:
         """Shrink (or grow) the block budget; evicts immediately. The

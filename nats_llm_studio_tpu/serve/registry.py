@@ -65,6 +65,42 @@ def _prefix_cache_blocks_env(default: int = 64) -> int:
     return default
 
 
+def _kv_paged_env(default: bool = True) -> bool:
+    """Paged-KV master switch: one refcounted block pool instead of
+    contiguous per-slot rings (serve/block_pool.py). Default ON;
+    ``KV_PAGED=0`` (or false/off) restores the pre-paged layout."""
+    env = os.environ.get("KV_PAGED", "").strip().lower()
+    if not env:
+        return default
+    return env not in ("0", "false", "off")
+
+
+def _kv_block_tokens_env(default: int = 16) -> int:
+    """Tokens per pool block (``KV_BLOCK_TOKENS``). The batcher snaps this
+    down (pow2 halving) until it divides the serving prefill chunk."""
+    env = os.environ.get("KV_BLOCK_TOKENS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer KV_BLOCK_TOKENS=%r", env)
+    return default
+
+
+def _kv_pool_blocks_env(default: int = 0) -> int:
+    """Pool population override (``KV_POOL_BLOCKS``). 0 = auto: every slot
+    at max_seq plus the whole prefix-cache budget (zero starvation).
+    Deployments under-provision here to pack more slots into the same HBM
+    — blocks only materialize per-token, which is the point of paging."""
+    env = os.environ.get("KV_POOL_BLOCKS", "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer KV_POOL_BLOCKS=%r", env)
+    return default
+
+
 def _spec_decode_env(default_k: int = 6) -> tuple[int, int]:
     """(spec_decode_k, spec_max_active) from the env (serve/spec.py).
     ``SPEC_DECODE=0`` (or false/off) is the hard off-switch; otherwise
@@ -335,6 +371,9 @@ class LocalRegistry(Registry):
         restart_window_s: float = 120.0,
         brownout: bool | None = None,
         deadline_min_tokens: int | None = None,
+        kv_paged: bool | None = None,
+        kv_block_tokens: int | None = None,
+        kv_pool_blocks: int | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -363,6 +402,22 @@ class LocalRegistry(Registry):
             prefix_cache_blocks
             if prefix_cache_blocks is not None
             else _prefix_cache_blocks_env()
+        )
+        # paged KV (serve/block_pool.py): one refcounted block pool shared
+        # by live slots, the prefix cache, and spec decode. HBM admission
+        # prices the POOL (not per-slot worst-case rows + a separate prefix
+        # budget) — see _estimate_load_bytes. None = read KV_PAGED /
+        # KV_BLOCK_TOKENS / KV_POOL_BLOCKS from the env.
+        self.kv_paged = kv_paged if kv_paged is not None else _kv_paged_env()
+        self.kv_block_tokens = (
+            kv_block_tokens
+            if kv_block_tokens is not None
+            else _kv_block_tokens_env()
+        )
+        self.kv_pool_blocks = (
+            kv_pool_blocks
+            if kv_pool_blocks is not None
+            else _kv_pool_blocks_env()
         )
         # adaptive brownout (serve/brownout.py) handed to every batcher;
         # None reads BROWNOUT from the env (default on), the BROWNOUT_*
@@ -541,7 +596,11 @@ class LocalRegistry(Registry):
                 "%d MiB (no eviction)", model_id, need >> 20, exc_info=True,
             )
         pbytes = 0
-        if self.prefix_cache_blocks > 0:
+        # paged mode: the prefix cache holds POOL block ids — its HBM is the
+        # pool's, already inside _estimate_load_bytes; pricing it separately
+        # would double-count (and _shrink_prefix_caches would then credit
+        # bytes the pool never gives back to the OS)
+        if self.prefix_cache_blocks > 0 and not self.kv_paged:
             try:
                 pbytes = await asyncio.to_thread(self._estimate_prefix_bytes, paths)
             except Exception:  # noqa: BLE001 — cache stays block-bounded anyway
@@ -588,7 +647,10 @@ class LocalRegistry(Registry):
 
     def _estimate_load_bytes(self, paths: list[str]) -> int:
         """Per-device estimate for serving this file with the registry's
-        settings (mesh sharding, weight/KV quant, slot count, seq len)."""
+        settings (mesh sharding, weight/KV quant, slot count, seq len).
+        Paged KV replaces the per-slot worst-case cache term with the ONE
+        pool's footprint (blocks x kv_pool_block_bytes) — the prefix cache
+        lives inside the same pool and is not priced separately."""
         from ..gguf.reader import is_split_shard
         from ..parallel.memory import estimate_device_bytes
 
@@ -597,10 +659,31 @@ class LocalRegistry(Registry):
             cfg = ModelConfig.from_gguf_metadata(reader.metadata).with_(dtype=self.dtype)
         mesh_shape = dict(self.mesh.shape) if self.mesh is not None else {}
         seq = min(self.max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
-        return estimate_device_bytes(
+        est = estimate_device_bytes(
             cfg, mesh_shape, quant=self.quant, batch=self.max_batch_slots,
             seq_len=seq, cache_dtype_bytes=1 if self.kv_quant == "int8" else None,
-        )["total"]
+        )
+        if not self.kv_paged:
+            return est["total"]
+        from ..parallel.memory import kv_pool_block_bytes
+        from .prefix_cache import serving_chunk
+
+        # mirror the batcher's block-size snap (T | serving chunk) and its
+        # auto pool population, +1 for the permanent null block
+        chunk = serving_chunk(seq)
+        T = max(1, self.kv_block_tokens)
+        while T > 1 and chunk % T:
+            T //= 2
+        nb = 1 + (
+            self.kv_pool_blocks
+            if self.kv_pool_blocks > 0
+            else self.max_batch_slots * max(1, seq // T)
+            + max(0, self.prefix_cache_blocks)
+        )
+        pool = nb * kv_pool_block_bytes(
+            cfg, T, kv_quant=self.kv_quant, tp=self._kv_tp(cfg)
+        )
+        return est["total"] - est["kv_cache"] + pool
 
     def _mesh_unservable(self, path: str) -> str | None:
         """Reason this worker's mesh cannot serve the GGUF at ``path``
@@ -765,6 +848,9 @@ class LocalRegistry(Registry):
             brownout=self.brownout_cfg,
             hbm_headroom_fn=self._hbm_headroom_frac,
             deadline_min_tokens=self.deadline_min_tokens,
+            paged=self.kv_paged,
+            kv_block_tokens=self.kv_block_tokens,
+            kv_pool_blocks=self.kv_pool_blocks,
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time

@@ -32,7 +32,14 @@ import os
 import time
 
 from ..config import WorkerConfig
-from ..obs import EVENTS, PromRenderer, Trace, new_trace_id
+from ..obs import (
+    EVENTS,
+    PromRenderer,
+    Trace,
+    compile_cache_counts,
+    install_compile_cache_listener,
+    new_trace_id,
+)
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
 from ..transport.protocol import DEADLINE_HEADER, TRACE_HEADER
@@ -94,6 +101,9 @@ class Worker:
 
     async def start(self) -> None:
         cfg = self.config
+        # count XLA compile-cache hits/misses from the very first engine
+        # load (idempotent; surfaces as lmstudio_compile_cache_*_total)
+        install_compile_cache_listener()
         self.nc = await connect(
             cfg.nats_url,
             name="tpu-worker",
@@ -534,6 +544,15 @@ class Worker:
                 help="tensor-parallel width of the serving mesh "
                      "(1 = unsharded serving)")
         r.gauge("lmstudio_events_emitted_total", EVENTS.emitted)
+        # XLA persistent-compile-cache effectiveness (obs/compile_cache.py;
+        # the listener is installed at worker start). Distinguishes "restart
+        # re-jitted from the cache in seconds" from "cache cold, every
+        # program paid a full compile" — the r05 e2e_long failure mode.
+        cc = compile_cache_counts()
+        r.counter("lmstudio_compile_cache_hits_total", cc["hits"],
+                  help="XLA persistent compile-cache hits in this process")
+        r.counter("lmstudio_compile_cache_misses_total", cc["misses"],
+                  help="XLA persistent compile-cache misses in this process")
         # fault-tolerance families — ALWAYS present (zero-valued when
         # nothing has failed) so dashboards and the chaos tests can assert
         # their existence, not just their increments
@@ -590,6 +609,20 @@ class Worker:
                     r.counter(f"lmstudio_spec_{name}_total", v, labels=labels)
             for name, h in stats.histograms().items():
                 r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
+            pool_stats_fn = getattr(eng.batcher, "pool_stats", None)
+            pool = pool_stats_fn() if pool_stats_fn is not None else None
+            if pool is not None:
+                # paged-KV block pool residency: total/free/shared block
+                # gauges prove the zero-copy prefix-sharing story (shared >
+                # 0 while a hit decodes; free returns to total after drain)
+                # and the CoW counter stays 0 under chunk-aligned sharing
+                for name in ("blocks_total", "blocks_free", "blocks_shared"):
+                    r.gauge(f"lmstudio_kv_pool_{name}", pool[name],
+                            labels=labels)
+                r.counter("lmstudio_kv_pool_cow_copies_total",
+                          pool["cow_copies"], labels=labels,
+                          help="copy-on-write block duplications (a shared "
+                               "block written by a live slot)")
             pcache = getattr(eng.batcher, "prefix_cache", None)
             if pcache is not None:
                 # two new families: lmstudio_prefix_cache_*_total counters

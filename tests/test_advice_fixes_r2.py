@@ -39,13 +39,16 @@ def model():
 # -- 2: batcher resets (not wedges) after a failed admit dispatch ------------
 
 
+@pytest.mark.parametrize("paged", [False, True])
 @async_test
-async def test_failed_admit_resets_batcher(model):
+async def test_failed_admit_resets_batcher(model, paged):
     cfg, params = model
-    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64, buckets=[8, 64])
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=64,
+                          buckets=[8, 64], paged=paged)
     sp = SamplingParams(temperature=0.0, max_tokens=64)
 
-    orig = b._admit_fused
+    attr = "_admit_fused_paged" if paged else "_admit_fused"
+    orig = getattr(b, attr)
     fail_next = {"on": False}
 
     def poisoned(*a, **kw):
@@ -54,7 +57,7 @@ async def test_failed_admit_resets_batcher(model):
             raise RuntimeError("simulated device OOM after donation")
         return orig(*a, **kw)
 
-    b._admit_fused = poisoned
+    setattr(b, attr, poisoned)
 
     # stream A occupies a slot and keeps decoding
     a_tokens = asyncio.Event()

@@ -408,13 +408,16 @@ async def test_ring_wrap_compaction_restores_windows(model):
     want_long = [t for t, _ in gen.generate(long_p, SamplingParams(temperature=0.0, max_tokens=248))]
     want_short = [t for t, _ in gen.generate(short_p, SamplingParams(temperature=0.0, max_tokens=60))]
 
-    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=S, buckets=buckets)
+    # paged=False: this test exercises the legacy ring layout's wrap +
+    # compaction machinery, which the paged block pool replaces outright
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=S,
+                          buckets=buckets, paged=False)
     try:
         got_long: list[int] = []
         got_short: list[int] = []
 
         async def run_long():
-            # A drives the ring head to ~251; its 28-token tail after B's
+            # A drives the ring head to ~251; its ~56-token tail after B's
             # trigger gives B several burst-records of margin to overlap
             sp = SamplingParams(temperature=0.0, max_tokens=248)
             async for t in b.submit(long_p, sp):
@@ -423,9 +426,11 @@ async def test_ring_wrap_compaction_restores_windows(model):
         async def run_short_late():
             # join near the wrap with a SMALL pos; survive the wrap (which
             # lands just after A exits), then the compaction re-rolls the
-            # ring around B's live window
-            while len(got_long) < 220:
-                await asyncio.sleep(0.002)
+            # ring around B's live window. Trigger at 192/248: late enough
+            # that B's 60 tokens span the wrap, early enough that B's admit
+            # beats A's exit even when a loaded CI host starves the loop
+            while len(got_long) < 192:
+                await asyncio.sleep(0.001)
             sp = SamplingParams(temperature=0.0, max_tokens=60)
             async for t in b.submit(short_p, sp):
                 got_short.append(t)

@@ -123,7 +123,10 @@ async def test_ring_compaction_quantized():
     want_long = [t for t, _ in gen.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=248))]
     want_short = [t for t, _ in gen.generate([4, 5, 6, 7], SamplingParams(temperature=0.0, max_tokens=60))]
 
-    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=256, buckets=buckets)
+    # paged=False: ring wrap/compaction is legacy-layout machinery; the
+    # paged pool never rolls (tested in test_paged_kv.py instead)
+    b = ContinuousBatcher(params, cfg, max_slots=2, max_seq_len=256,
+                          buckets=buckets, paged=False)
     try:
         got_long, got_short = [], []
 
