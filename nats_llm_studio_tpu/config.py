@@ -188,6 +188,28 @@ class WorkerConfig:
         default_factory=lambda: _env("BROWNOUT", "1").strip().lower()
         not in ("0", "false", "off")
     )
+    # -- flight recorder + debug subjects (obs/recorder.py) ------------------
+    # bounded ring of periodic batcher state frames, sampled by the owner
+    # loop; anomaly-triggered dumps (engine restart, pool exhaustion,
+    # SHED_ONLY entry, slow requests) write frames + event tail + trace to
+    # OBS_DUMP_DIR. OBS_RECORDER=0 disables sampling and dumps entirely;
+    # an empty OBS_DUMP_DIR keeps the in-memory ring (debug.snapshot still
+    # serves it) but writes nothing to disk.
+    obs_recorder: bool = field(
+        default_factory=lambda: _env("OBS_RECORDER", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    obs_recorder_interval_ms: float = field(
+        default_factory=lambda: float(_env("OBS_RECORDER_INTERVAL_MS", "250"))
+    )
+    obs_dump_dir: str = field(default_factory=lambda: _env("OBS_DUMP_DIR", "").strip())
+    # deep-introspection subjects (lmstudio.debug.snapshot / .dump): off by
+    # default — they expose slot tables and can force disk writes, so only
+    # operators who opt in get them on the bus
+    debug_subjects: bool = field(
+        default_factory=lambda: _env("DEBUG_SUBJECTS", "0").strip().lower()
+        in ("1", "true", "on")
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

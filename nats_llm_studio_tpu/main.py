@@ -98,6 +98,9 @@ async def _run_serve(args: argparse.Namespace) -> None:
         restart_backoff_max_s=cfg.engine_restart_backoff_max_s,
         max_restarts=cfg.engine_max_restarts,
         restart_window_s=cfg.engine_restart_window_s,
+        obs_recorder=cfg.obs_recorder,
+        obs_recorder_interval_ms=cfg.obs_recorder_interval_ms,
+        obs_dump_dir=cfg.obs_dump_dir,
     )
     worker = Worker(cfg, registry)
     await worker.start()

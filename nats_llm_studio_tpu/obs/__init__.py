@@ -9,12 +9,14 @@ from .compile_cache import compile_cache_counts, install_compile_cache_listener
 from .events import EVENTS, EventRing, emit
 from .histogram import HistSnapshot, LogHistogram
 from .prom import PromRenderer
+from .recorder import FlightRecorder
 from .trace import STAGES, Trace, new_trace_id
 
 __all__ = [
     "EVENTS",
     "EventRing",
     "emit",
+    "FlightRecorder",
     "compile_cache_counts",
     "install_compile_cache_listener",
     "HistSnapshot",

@@ -27,7 +27,15 @@ class EventRing:
         self._lock = threading.Lock()
 
     def emit(self, kind: str, **fields) -> dict:
-        ev = {"kind": kind, "ts": round(time.time(), 3), **fields}
+        # both clocks: wall for humans/log-correlation, monotonic so
+        # events line up with trace marks and recorder frames (which are
+        # monotonic-stamped) without cross-clock arithmetic
+        ev = {
+            "kind": kind,
+            "ts": round(time.time(), 3),
+            "mono": round(time.monotonic(), 3),
+            **fields,
+        }
         with self._lock:
             ev["seq"] = self._seq
             self._buf[self._seq % self.capacity] = ev

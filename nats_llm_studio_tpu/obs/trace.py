@@ -28,10 +28,14 @@ def new_trace_id() -> str:
 
 
 class Trace:
-    __slots__ = ("trace_id", "_marks", "_lock")
+    __slots__ = ("trace_id", "attempt", "_marks", "_lock")
 
-    def __init__(self, trace_id: str | None = None):
+    def __init__(self, trace_id: str | None = None, attempt: int | None = None):
         self.trace_id = trace_id or new_trace_id()
+        # retry attempt number (1-based) stamped from the X-Attempt
+        # header: one trace id spans all attempts of a retried request,
+        # so the attempt tag is what tells the spans apart
+        self.attempt = attempt
         self._marks: dict[str, float] = {}
         self._lock = threading.Lock()
 
@@ -75,4 +79,7 @@ class Trace:
                 if a in marks and b in marks:
                     spans[name] = round(max(0.0, marks[b] - marks[a]) * 1e3, 3)
             spans["total_ms"] = round(max(0.0, ordered[-1][1] - t0) * 1e3, 3)
-        return {"trace_id": self.trace_id, "spans_ms": spans, "marks_ms": offsets}
+        out = {"trace_id": self.trace_id, "spans_ms": spans, "marks_ms": offsets}
+        if self.attempt is not None:
+            out["attempt"] = self.attempt
+        return out

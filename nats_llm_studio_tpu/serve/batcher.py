@@ -54,7 +54,7 @@ from ..ops.kvcache import (
     kv_slice,
 )
 from .block_pool import BlockPool
-from .brownout import SHED_ONLY, BrownoutConfig, BrownoutController
+from .brownout import LEVEL_NAMES, SHED_ONLY, BrownoutConfig, BrownoutController
 from .prefix_cache import PrefixCache
 from .spec import SpecConfig, SpecSlot, make_slot
 
@@ -155,7 +155,42 @@ class BatcherStats:
     # "depth" | "age" | "deadline" | "brownout" -> count
     shed_causes: dict = field(default_factory=dict)
     cancel_causes: dict = field(default_factory=dict)  # where the cancel landed
+    # per-program device telemetry: one histogram per jit-grid program
+    # (prefill1, decode_pos_paged, spec_verify, ...) of host dispatch wall
+    # ms, plus tokens moved per dispatch. decode_step_ms stays the
+    # readback-inclusive stream-experienced number; these decompose WHERE
+    # the device time goes (a first call's entry includes its XLA compile,
+    # which is exactly the spike worth seeing). Keys materialize on first
+    # record; exposition copies the dict under the lock.
+    program_ms: dict = field(default_factory=dict)  # name -> LogHistogram
+    program_tokens: dict = field(default_factory=dict)  # name -> LogHistogram
     _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record_program(self, name: str, ms: float, tokens: float | None = None) -> None:
+        """One jit-grid dispatch of ``name`` took ``ms`` (host wall: on an
+        async backend this is dispatch time — execution may still be in
+        flight — but a cold call's trace+compile is fully in here)."""
+        h = self.program_ms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.program_ms.setdefault(name, LogHistogram())
+        h.record(ms)
+        if tokens is not None and tokens > 0:
+            ht = self.program_tokens.get(name)
+            if ht is None:
+                with self._lock:
+                    ht = self.program_tokens.setdefault(
+                        name, LogHistogram(lo=1.0, hi=1e6, growth=1.25)
+                    )
+            ht.record(float(tokens))
+
+    def program_histograms(self) -> dict[str, LogHistogram]:
+        with self._lock:
+            return dict(self.program_ms)
+
+    def program_token_histograms(self) -> dict[str, LogHistogram]:
+        with self._lock:
+            return dict(self.program_tokens)
 
     def record_admit_delay(self, ms: float) -> None:
         """Queue delay (enqueue -> admit DISPATCH), ms — the scheduling
@@ -282,6 +317,7 @@ class ContinuousBatcher:
         paged: bool | None = None,
         kv_block_tokens: int = 16,
         kv_pool_blocks: int = 0,
+        recorder=None,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -440,7 +476,21 @@ class ContinuousBatcher:
         # 0.0 = no sample yet (feasibility then only sheds the already-expired)
         self._prefill_rate_ewma = 0.0
         self._decode_spt_ewma = 0.0
+        # per-verify draft acceptance EWMA (owner thread only) — the
+        # recorder frame's one-number answer to "is spec still paying?"
+        self._spec_accept_ewma = 0.0
         self.stats = BatcherStats()
+        # flight recorder (obs/recorder.py): the owner loop samples one
+        # frame per interval and the anomaly paths (crash, pool
+        # exhaustion, SHED_ONLY entry) dump through it; None = off
+        self.recorder = recorder
+        # owner-maintained snapshot of the live slots for debug_snapshot()
+        # (the real tables/host_pos are _run locals): slot -> {pos,
+        # generated, blocks, ...}. Replaced wholesale each loop iteration
+        # and entries popped at finish_slot, so an idle (inbox-blocked)
+        # owner never leaves freed slots visible. Read from any thread —
+        # plain dict ref swap is atomic under the GIL.
+        self._slot_view: dict[int, dict] = {}
 
         fwd = partial(forward, cfg=cfg, mesh=mesh)
 
@@ -1033,29 +1083,33 @@ class ContinuousBatcher:
                     pin_pool(kv_pool_copy_block(VP, dst, src)),
                 )
 
-            self._sample_first = sample_first
-            self._admit_fused_paged = admit_fused_paged
-            self._admit_many_fused_paged = admit_many_fused_paged
-            self._finish_admit_paged = finish_admit_paged
-            self._finish_admit_group_paged = finish_admit_group_paged
-            self._fill_row_chunk = fill_row_chunk
-            self._decode_pos_paged = decode_pos_paged
-            self._spec_verify_paged = spec_verify_paged
-            self._pool_copy_block = pool_copy_block
+            self._sample_first = self._timed("sample_first", sample_first)
+            self._admit_fused_paged = self._timed("admit_fused_paged", admit_fused_paged)
+            self._admit_many_fused_paged = self._timed(
+                "admit_many_fused_paged", admit_many_fused_paged
+            )
+            self._finish_admit_paged = self._timed("finish_admit_paged", finish_admit_paged)
+            self._finish_admit_group_paged = self._timed(
+                "finish_admit_group_paged", finish_admit_group_paged
+            )
+            self._fill_row_chunk = self._timed("fill_row_chunk", fill_row_chunk)
+            self._decode_pos_paged = self._timed("decode_pos_paged", decode_pos_paged)
+            self._spec_verify_paged = self._timed("spec_verify_paged", spec_verify_paged)
+            self._pool_copy_block = self._timed("pool_copy_block", pool_copy_block)
 
-        self._prefill1 = prefill1
-        self._prefill_full = prefill_full
-        self._write_prefix_block = write_prefix_block
-        self._admit_fused = admit_fused
-        self._admit_many_fused = admit_many_fused
-        self._finish_admit = finish_admit
-        self._prefill_chunk_group = prefill_chunk_group
-        self._select_end = select_end
-        self._finish_admit_group = finish_admit_group
-        self._decode = decode
-        self._decode_pos = decode_pos
-        self._spec_verify = spec_verify
-        self._compact_ring = compact_ring
+        self._prefill1 = self._timed("prefill1", prefill1)
+        self._prefill_full = self._timed("prefill_full", prefill_full)
+        self._write_prefix_block = self._timed("write_prefix_block", write_prefix_block)
+        self._admit_fused = self._timed("admit_fused", admit_fused)
+        self._admit_many_fused = self._timed("admit_many_fused", admit_many_fused)
+        self._finish_admit = self._timed("finish_admit", finish_admit)
+        self._prefill_chunk_group = self._timed("prefill_chunk_group", prefill_chunk_group)
+        self._select_end = self._timed("select_end", select_end)
+        self._finish_admit_group = self._timed("finish_admit_group", finish_admit_group)
+        self._decode = self._timed("decode", decode)
+        self._decode_pos = self._timed("decode_pos", decode_pos)
+        self._spec_verify = self._timed("spec_verify", spec_verify)
+        self._compact_ring = self._timed("compact_ring", compact_ring)
 
         self._inbox: _queue.Queue[_Request | None] = _queue.Queue()
         # cancel notices for the owner thread (consumer-gone requests); the
@@ -1080,6 +1134,24 @@ class ContinuousBatcher:
         self.heartbeat = time.monotonic()
         self.crashed: BaseException | None = None
         self._waitlist: list[_Request] = []
+
+    def _timed(self, name: str, fn):
+        """Wrap one jit-grid program so every dispatch lands in
+        stats.program_ms[name] (and, when the caller passes ``_tokens=``,
+        tokens-per-dispatch in program_tokens[name]). Times the host-side
+        call only — it never blocks on the result, so the depth-2 decode
+        pipeline is untouched; decode_step_ms remains the
+        readback-inclusive per-step number."""
+        stats = self.stats
+
+        def run(*args, _tokens=None, **kwargs):
+            t0 = time.monotonic()
+            out = fn(*args, **kwargs)
+            stats.record_program(name, (time.monotonic() - t0) * 1e3, _tokens)
+            return out
+
+        run.__name__ = f"timed_{name}"
+        return run
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1108,6 +1180,17 @@ class ContinuousBatcher:
                 "engine_crash", error=f"{type(e).__name__}: {e}",
                 inflight_failed=n,
             )
+            if self.recorder is not None:
+                # the pre-crash timeline is exactly what the recorder is
+                # for; the supervisor's restart writes a second (forced)
+                # dump whose event tail includes the restart itself
+                self.recorder.dump(
+                    "engine_crash",
+                    extra={
+                        "error": f"{type(e).__name__}: {e}",
+                        "inflight_failed": n,
+                    },
+                )
 
     def _fail_inflight_retryable(self, cause: BaseException) -> int:
         """Fail every in-flight and queued request with a BatcherStopped
@@ -1137,6 +1220,7 @@ class ContinuousBatcher:
             if isinstance(req, _Request):
                 fail(req)
             self._slots[i] = None
+        self._slot_view = {}
         while True:
             try:
                 req = self._inbox.get_nowait()
@@ -1151,6 +1235,77 @@ class ContinuousBatcher:
         """Current degradation level (0 normal / 1 brownout / 2 shed-only);
         0 when the controller is off. Plain int read — safe cross-thread."""
         return self.brownout.level if self.brownout is not None else 0
+
+    def _recorder_frame(self, depth: int, n_active: int) -> dict:
+        """One compact flight-recorder frame (owner thread). Everything in
+        here must be O(1)-ish: this runs once per OBS_RECORDER_INTERVAL_MS
+        inside the pump loop."""
+        st = self.stats
+        fr = {
+            "queue_depth": depth,
+            "active_slots": n_active,
+            "brownout_level": self.brownout_level,
+            "decode_spt_ewma_ms": round(self._decode_spt_ewma * 1e3, 3),
+            "spec_accept_ewma": round(self._spec_accept_ewma, 3),
+            "requests": st.requests,
+            "tokens": st.tokens,
+            "shed": st.shed,
+            "cancelled": st.cancelled,
+            "inflight_failed_retryable": st.inflight_failed_retryable,
+        }
+        if self.hbm_headroom_fn is not None:
+            try:
+                hr = self.hbm_headroom_fn()
+            except Exception:  # noqa: BLE001 — probe is best-effort
+                hr = None
+            if hr is not None:
+                fr["hbm_headroom_frac"] = round(hr, 4)
+        if self._pool is not None:
+            ps = self._pool.stats()
+            fr["pool_blocks_free"] = ps["blocks_free"]
+            fr["pool_blocks_live"] = ps["blocks_live"]
+            fr["pool_blocks_shared"] = ps["blocks_shared"]
+        return fr
+
+    def debug_snapshot(self) -> dict:
+        """Deep live-state view for ``lmstudio.debug.snapshot``: the slot
+        table (per-slot positions and block tables with refcounts), pool
+        and prefix-cache summaries, brownout controller state, and the
+        recorder ring tail. Safe from any thread — reads the owner's
+        wholesale-replaced ``_slot_view`` plus the pool's locked stats."""
+        pool = self._pool
+        view = self._slot_view  # one GIL-atomic ref read
+        slots: dict[int, dict] = {}
+        for i, ent in sorted(view.items()):
+            e = dict(ent)
+            if pool is not None and e.get("blocks"):
+                e["block_refcounts"] = [pool.refcount(b) for b in e["blocks"]]
+            slots[i] = e
+        snap: dict = {
+            "max_slots": self.max_slots,
+            "max_seq": self.max_seq,
+            "paged": self.paged,
+            "kv_block_tokens": self.kv_block_tokens,
+            "queue_depth": self._wl_len + self._inbox.qsize(),
+            "slots": slots,
+            "decode_spt_ewma_ms": round(self._decode_spt_ewma * 1e3, 3),
+            "spec_accept_ewma": round(self._spec_accept_ewma, 3),
+        }
+        bo = self.brownout
+        if bo is not None:
+            snap["brownout"] = {
+                "level": bo.level,
+                "level_name": LEVEL_NAMES[bo.level],
+                "transitions": bo.transitions,
+            }
+        if pool is not None:
+            snap["pool"] = pool.stats()
+        if self.prefix_cache is not None:
+            snap["prefix_cache"] = self.prefix_cache.stats()
+        if self.recorder is not None:
+            snap["recorder_tail"] = self.recorder.tail(20)
+            snap["recorder_frames_sampled"] = self.recorder.frames_sampled
+        return snap
 
     def _note_prefill_rate(self, tokens: int, seconds: float) -> None:
         if seconds <= 0 or tokens <= 0:
@@ -1554,6 +1709,13 @@ class ContinuousBatcher:
                 got = pool.alloc(k)
             if got is None:
                 self.stats.record_shed("kv_pool")
+                if self.recorder is not None:
+                    # rate-limited (not forced): a starved pool sheds every
+                    # admit attempt, one dump per window tells the story
+                    self.recorder.dump(
+                        "kv_pool_exhausted",
+                        extra={"needed": k, "free": pool.free_blocks},
+                    )
                 raise _PoolExhausted(
                     f"kv block pool exhausted ({k} blocks needed, "
                     f"{pool.free_blocks} free); retry on another worker"
@@ -1658,6 +1820,9 @@ class ContinuousBatcher:
             host_pos[i] = 0
             host_steps[i] = 0
             spec_slots[i] = None
+            # keep the cross-thread slot view honest even when the loop is
+            # about to block idle on the inbox (no rebuild tick follows)
+            self._slot_view.pop(i, None)
             nonlocal dirty, table_dirty
             dirty = True
             if paged and tables[i]:
@@ -1666,6 +1831,29 @@ class ContinuousBatcher:
                 pool.decref(tables[i])
                 tables[i] = []
                 table_dirty = True
+
+        def rebuild_slot_view() -> None:
+            """Refresh the cross-thread slot snapshot (debug_snapshot's
+            data source) from the owner-local tables/positions. Replaced
+            wholesale — readers see one consistent dict via the GIL-atomic
+            ref swap; block lists are copies, never the live tables."""
+            view: dict[int, dict] = {}
+            for i, r in enumerate(self._slots):
+                if not isinstance(r, _Request):
+                    continue
+                ent = {
+                    "pos": host_pos[i],
+                    "prompt_tokens": len(r.prompt_ids),
+                    "generated": r.generated,
+                    "max_tokens": r.sp.max_tokens,
+                    "cancelled": r.cancelled,
+                }
+                if r.trace is not None:
+                    ent["trace_id"] = r.trace.trace_id
+                if paged:
+                    ent["blocks"] = list(tables[i])
+                view[i] = ent
+            self._slot_view = view
 
         def process_record(rec) -> None:
             """Block on one in-flight dispatch's readback, deliver tokens.
@@ -1723,8 +1911,11 @@ class ContinuousBatcher:
                     if dlen > 0:
                         self.stats.spec_drafted += dlen
                         self.stats.spec_accepted += n_emit - 1
-                        self.stats.spec_accept_rate.record(
-                            max((n_emit - 1) / dlen, 0.01)
+                        rate = (n_emit - 1) / dlen
+                        self.stats.spec_accept_rate.record(max(rate, 0.01))
+                        prev = self._spec_accept_ewma
+                        self._spec_accept_ewma = (
+                            rate if prev == 0.0 else 0.8 * prev + 0.2 * rate
                         )
                     if req.cancelled:
                         finish_slot(slot)
@@ -1888,6 +2079,7 @@ class ContinuousBatcher:
                     self._decode_pos_paged(
                         self.params, tok_dev, K, V, tbl_dev, pos_dev,
                         seeds_dev, steps_dev, temp, topk, topp, n, nb,
+                        _tokens=len(act) * n,
                     )
                 )
             elif positional:
@@ -1899,6 +2091,7 @@ class ContinuousBatcher:
                 toks, K, V, tok_dev, pos_dev, steps_dev = self._decode_pos(
                     self.params, tok_dev, K, V, pos_dev,
                     seeds_dev, steps_dev, temp, topk, topp, n, window,
+                    _tokens=len(act) * n,
                 )
             else:
                 # until the ring wraps, every live slot index is < ring_next:
@@ -1912,6 +2105,7 @@ class ContinuousBatcher:
                 toks, K, V, tok_dev, pos_dev, steps_dev = self._decode(
                     self.params, tok_dev, K, V, pos_dev, jnp.int32(self._ring_next),
                     seeds_dev, steps_dev, temp, topk, topp, n, window,
+                    _tokens=len(act) * n,
                 )
                 if self._ring_next + n >= self.max_seq:
                     self._ring_wrapped = True
@@ -1968,6 +2162,7 @@ class ContinuousBatcher:
                         self.params, tok_dev, K, V, tbl_dev, pos_dev,
                         jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
                         seeds_dev, steps_dev, temp, topk, topp, nb,
+                        _tokens=len(act) * (kspec + 1),
                     )
                 )
             else:
@@ -1977,6 +2172,7 @@ class ContinuousBatcher:
                     self.params, tok_dev, K, V, pos_dev,
                     jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
                     seeds_dev, steps_dev, temp, topk, topp, window,
+                    _tokens=len(act) * (kspec + 1),
                 )
             self.stats.steps += 1
             self.stats.spec_verifies += 1
@@ -2064,6 +2260,7 @@ class ContinuousBatcher:
                 first, K, V, tok_dev = self._admit_fused_paged(
                     self.params, K, V, tok_dev, tokens, jnp.int32(n),
                     jnp.asarray(bids, jnp.int32), jnp.int32(slot), *samp,
+                    _tokens=n,
                 )
                 return first
             # long prompt: same regime choices as the legacy path (see
@@ -2127,6 +2324,7 @@ class ContinuousBatcher:
                                 [min(n - 1 - start, C - 1)], jnp.int32
                             ),
                             self._win_bucket(start + C),
+                            _tokens=min(C, n - start),
                         )
                         if start + C <= n:
                             chunk_logits[start // C] = logits
@@ -2141,6 +2339,7 @@ class ContinuousBatcher:
                     logits, k1, v1 = self._prefill_full(
                         self.params, jnp.asarray([toks], jnp.int32), k1, v1,
                         jnp.int32(n),
+                        _tokens=n,
                     )
                     if chunk_logits is not None and n_full and n % C == 0:
                         chunk_logits[n_full - 1] = logits
@@ -2158,6 +2357,7 @@ class ContinuousBatcher:
                                 [min(n - 1 - start, C - 1)], jnp.int32
                             ),
                             self._win_bucket(start + C),
+                            _tokens=min(C, n - start),
                         )
                         if chunk_logits is not None and start + C <= n:
                             chunk_logits[start // C] = logits
@@ -2241,6 +2441,7 @@ class ContinuousBatcher:
                 first, K, V, tok_dev = self._admit_fused(
                     self.params, K, V, tok_dev, tokens, jnp.int32(n),
                     jnp.int32(slot), shift, *samp,
+                    _tokens=n,
                 )
             else:
                 # long prompt. PREFIX-CACHE hit: copy the cached chunk
@@ -2300,6 +2501,7 @@ class ContinuousBatcher:
                                         [min(n - 1 - start, C - 1)], jnp.int32
                                     ),
                                     self._win_bucket(start + C),
+                                    _tokens=min(C, n - start),
                                 )
                                 if start + C <= n:
                                     chunk_logits[start // C] = logits
@@ -2320,6 +2522,7 @@ class ContinuousBatcher:
                         logits, k1, v1 = self._prefill_full(
                             self.params, jnp.asarray([toks], jnp.int32), k1, v1,
                             jnp.int32(n),
+                            _tokens=n,
                         )
                         # only the prompt-end row exists here; chunk-end
                         # rows for interior chunks are backfilled if a
@@ -2336,6 +2539,7 @@ class ContinuousBatcher:
                                 jnp.full((1,), start, jnp.int32),
                                 jnp.asarray([min(n - 1 - start, C - 1)], jnp.int32),
                                 self._win_bucket(start + C),
+                                _tokens=min(C, n - start),
                             )
                             if chunk_logits is not None and start + C <= n:
                                 chunk_logits[start // C] = logits
@@ -2447,6 +2651,7 @@ class ContinuousBatcher:
                         ),
                         jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
                         jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
+                        _tokens=sum(ns[i] for i in idx),
                     )
                 else:
                     firsts, K, V, tok_dev = self._admit_many_fused(
@@ -2462,6 +2667,7 @@ class ContinuousBatcher:
                         jnp.asarray([reqs[i].sp.temperature for i in idx], jnp.float32),
                         jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
                         jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
+                        _tokens=sum(ns[i] for i in idx),
                     )
             except BaseException:
                 for s in slots:  # release reservations; caller emits the error
@@ -2568,6 +2774,7 @@ class ContinuousBatcher:
                         jnp.full((mpad,), start, jnp.int32),
                         jnp.asarray(last_pos, jnp.int32),
                         self._win_bucket(start + C),
+                        _tokens=mpad * C,
                     )
                     final = self._select_end(
                         final, logits,
@@ -2750,13 +2957,21 @@ class ContinuousBatcher:
                         self._wl_len = len(waitlist)
             drain_cancels(waitlist)
             now = time.monotonic()
+            depth = len(waitlist) + self._inbox.qsize()
+            rebuild_slot_view()
+            rec = self.recorder
+            if rec is not None and rec.due(now):
+                rec.sample(
+                    self._recorder_frame(depth=depth, n_active=len(active())),
+                    now=now,
+                )
             bo = self.brownout
+            lvl_before = bo.level if bo is not None else SHED_ONLY
             if bo is not None:
                 # controller tick: queue depth as a fraction of the
                 # (configured, or nominal 4x-slots) limit, queue-age p95
                 # over the current waiters, HBM headroom via the
                 # registry-injected probe
-                depth = len(waitlist) + self._inbox.qsize()
                 limit = self.max_queue or 4 * self.max_slots
                 ages = sorted((now - r.t_enq) * 1e3 for r in waitlist)
                 age_p95 = ages[max(0, int(len(ages) * 0.95) - 1)] if ages else 0.0
@@ -2768,6 +2983,18 @@ class ContinuousBatcher:
                         headroom_frac = None
                 bo.update(depth_frac=depth / limit, age_p95_ms=age_p95,
                           hbm_headroom_frac=headroom_frac, now=now)
+                if (
+                    bo.level == SHED_ONLY
+                    and lvl_before < SHED_ONLY
+                    and rec is not None
+                ):
+                    # entering full shed is an incident, not a metric blip:
+                    # capture the ramp that led here (rate-limited)
+                    rec.dump(
+                        "shed_only_entry",
+                        extra={"depth": depth, "age_p95_ms": round(age_p95, 1),
+                               "hbm_headroom_frac": headroom_frac},
+                    )
             # deadline sweep, queued side: waiters whose budget already ran
             # out — or whose remaining budget the live rate EWMAs say cannot
             # cover prefill plus the token floor — are shed BEFORE any
@@ -3085,6 +3312,7 @@ class ContinuousBatcher:
         # so zero the waitlist mirror unconditionally — a stopped batcher
         # must read as idle (the registry's eviction check relies on it)
         self._wl_len = 0
+        self._slot_view = {}
         for req in waitlist:
             req.emit("end", reason)
         if isinstance(waitlist, list):

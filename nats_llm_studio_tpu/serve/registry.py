@@ -21,7 +21,7 @@ from ..gguf.reader import open_gguf
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
-from ..obs import LogHistogram
+from ..obs import FlightRecorder, LogHistogram
 from ..obs import emit as obs_emit
 from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
@@ -374,6 +374,9 @@ class LocalRegistry(Registry):
         kv_paged: bool | None = None,
         kv_block_tokens: int | None = None,
         kv_pool_blocks: int | None = None,
+        obs_recorder: bool | None = None,
+        obs_recorder_interval_ms: float | None = None,
+        obs_dump_dir: str | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -460,6 +463,31 @@ class LocalRegistry(Registry):
         # Prometheus total survives the batcher object being dropped
         self.inflight_failed_retryable = 0
         self.restart_latency_ms = LogHistogram()
+        # flight recorder (obs/recorder.py): per-engine frame rings sampled
+        # by each batcher's owner loop; None ctor args read OBS_RECORDER /
+        # OBS_RECORDER_INTERVAL_MS / OBS_DUMP_DIR from the env
+        self.obs_recorder = (
+            obs_recorder
+            if obs_recorder is not None
+            else os.environ.get("OBS_RECORDER", "1").strip().lower()
+            not in ("0", "false", "off")
+        )
+        self.obs_recorder_interval_ms = (
+            obs_recorder_interval_ms
+            if obs_recorder_interval_ms is not None
+            else float(os.environ.get("OBS_RECORDER_INTERVAL_MS", "").strip() or "250")
+        )
+        self.obs_dump_dir = (
+            obs_dump_dir
+            if obs_dump_dir is not None
+            else os.environ.get("OBS_DUMP_DIR", "").strip()
+        )
+        # process-level counters merged into every recorder frame so
+        # restart/reconnect counts sit on the same timeline as queue depth;
+        # the worker registers its transport's reconnect counter here
+        self.recorder_counters: dict[str, Any] = {
+            "engine_restarts": lambda: self.engine_restarts_total,
+        }
 
     # -- Registry ------------------------------------------------------------
 
@@ -838,6 +866,13 @@ class LocalRegistry(Registry):
             params = ensure_lm_head(load_params_from_gguf(reader, cfg))
         meta = dict(reader.metadata)
         reader.close()
+        recorder = FlightRecorder(
+            enabled=self.obs_recorder,
+            interval_ms=self.obs_recorder_interval_ms,
+            dump_dir=self.obs_dump_dir,
+            engine=model_id,
+            counter_fns=self.recorder_counters,
+        )
         batcher = ContinuousBatcher(
             params, cfg, max_slots=self.max_batch_slots, max_seq_len=self.max_seq_len,
             mesh=self.mesh, max_queue=self.admit_queue_limit,
@@ -851,6 +886,7 @@ class LocalRegistry(Registry):
             paged=self.kv_paged,
             kv_block_tokens=self.kv_block_tokens,
             kv_pool_blocks=self.kv_pool_blocks,
+            recorder=recorder,
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time
@@ -885,11 +921,16 @@ class LocalRegistry(Registry):
             self._prefix_bytes.pop(model_id, None)
             self._last_used.pop(model_id, None)
             b = eng.batcher
+            recorder = None
             if b is not None:
                 # keep the Prometheus total alive past this batcher object
                 self.inflight_failed_retryable += getattr(
                     b.stats, "inflight_failed_retryable", 0
                 )
+                # the dying batcher's flight recorder holds the pre-crash
+                # timeline; keep it past unload so the restart dump below
+                # can write it out
+                recorder = getattr(b, "recorder", None)
             await eng.unload()
             obs_emit("engine_unload", model=model_id, reason=reason)
             now = time.monotonic()
@@ -923,6 +964,16 @@ class LocalRegistry(Registry):
                  model_id, latency_ms, reason)
         obs_emit("engine_restart", model=model_id, reason=reason,
                  ms=round(latency_ms, 1))
+        if recorder is not None:
+            # after the engine_restart emit, so the dump's event tail
+            # contains the restart itself; force past the rate limiter —
+            # the crash dump seconds earlier must not suppress this one
+            recorder.dump(
+                "engine_restart",
+                force=True,
+                extra={"model": model_id, "restart_reason": reason,
+                       "restart_ms": round(latency_ms, 1)},
+            )
         return "restarted"
 
     def engine_health(self) -> dict[str, dict[str, Any]]:

@@ -42,7 +42,7 @@ from ..obs import (
 )
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
-from ..transport.protocol import DEADLINE_HEADER, TRACE_HEADER
+from ..transport.protocol import ATTEMPT_HEADER, DEADLINE_HEADER, TRACE_HEADER
 from .api import EngineError, ModelNotFound, Registry
 
 log = logging.getLogger(__name__)
@@ -125,6 +125,19 @@ class Worker:
             cfg.subject("events"): self.on_events,
             cfg.subject("profile"): self.on_profile,
         }
+        if getattr(cfg, "debug_subjects", False):
+            # deep-debug surface (DEBUG_SUBJECTS=1 only): slot tables with
+            # block refcounts expose request shapes and debug.dump forces
+            # disk writes, so the subjects simply don't exist by default
+            subs[cfg.subject("debug.snapshot")] = self.on_debug_snapshot
+            subs[cfg.subject("debug.dump")] = self.on_debug_dump
+        # flight-recorder frames carry worker-level counters too: register
+        # them with the registry so every engine's recorder sees them
+        # (FakeRegistry in tests has no recorder_counters — guard)
+        counters = getattr(self.registry, "recorder_counters", None)
+        if counters is not None:
+            counters["reconnects"] = lambda: getattr(self.nc, "reconnects", 0)
+            counters["requests_total"] = lambda: self._requests_total
         for subject, handler in subs.items():
             await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
         await self.nc.flush()
@@ -310,7 +323,12 @@ class Worker:
         ``trace_id`` and the response ``stats.trace`` holds the waterfall —
         no extra round-trip."""
         self._requests_total += 1
-        trace = Trace((msg.headers or {}).get(TRACE_HEADER) or new_trace_id())
+        hdrs = msg.headers or {}
+        try:
+            attempt = int(hdrs[ATTEMPT_HEADER]) if ATTEMPT_HEADER in hdrs else None
+        except (TypeError, ValueError):
+            attempt = None
+        trace = Trace(hdrs.get(TRACE_HEADER) or new_trace_id(), attempt=attempt)
         trace.mark("recv")
         if not msg.payload:
             await self._respond_error(msg, "empty payload in ChatModel", trace_id=trace.trace_id)
@@ -396,6 +414,17 @@ class Worker:
                 total_ms=total_ms,
                 spans_ms=report["spans_ms"],
             )
+            # attach the offending request's waterfall to a flight dump so
+            # the pre-slowness frames (queue depth, brownout, pool state)
+            # land next to the trace that suffered them
+            eng = self.registry.loaded_engines().get(model_id)
+            recorder = getattr(getattr(eng, "batcher", None), "recorder", None)
+            if recorder is not None:
+                recorder.dump(
+                    "slow_request",
+                    trace=report,
+                    extra={"model": model_id, "total_ms": round(total_ms, 1)},
+                )
 
     async def _error_terminal(
         self, msg: Msg, error: str, data, streaming: bool, trace: Trace | None = None
@@ -609,6 +638,18 @@ class Worker:
                     r.counter(f"lmstudio_spec_{name}_total", v, labels=labels)
             for name, h in stats.histograms().items():
                 r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
+            if hasattr(stats, "program_histograms"):
+                # per-program device dispatch timing: every jit-grid program
+                # the batcher launched, as one labeled histogram family —
+                # answers "which program got slow" without a profiler run.
+                # Host-side dispatch time only (the pump never blocks on the
+                # result here); cold entries include XLA compile time.
+                for name, h in sorted(stats.program_histograms().items()):
+                    r.histogram("lmstudio_program_ms", h.snapshot(),
+                                labels={**labels, "program": name})
+                for name, h in sorted(stats.program_token_histograms().items()):
+                    r.histogram("lmstudio_program_tokens", h.snapshot(),
+                                labels={**labels, "program": name})
             pool_stats_fn = getattr(eng.batcher, "pool_stats", None)
             pool = pool_stats_fn() if pool_stats_fn is not None else None
             if pool is not None:
@@ -715,3 +756,65 @@ class Worker:
         finally:
             self._profiling = False
         await self._respond_ok(msg, {"trace_dir": trace_dir, "seconds": seconds})
+
+    # -- deep-debug subjects (DEBUG_SUBJECTS=1 only) -------------------------
+
+    async def on_debug_snapshot(self, msg: Msg) -> None:
+        """debug.snapshot — live internals of every loaded engine's batcher:
+        per-slot positions and block tables (with refcounts), prefix-cache
+        radix summary, brownout state, and the flight recorder's frame tail.
+        Payload (optional): ``{model?}`` restricts to one engine. Read-only
+        and point-in-time consistent per engine (the slot view is swapped
+        wholesale by the owner loop), but not across engines."""
+        try:
+            req = json.loads(msg.payload) if msg.payload and msg.payload.strip() else {}
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in DebugSnapshot: {e}")
+            return
+        want = (req.get("model") or "").strip() or None
+        engines = {}
+        for mid, eng in self.registry.loaded_engines().items():
+            if want is not None and mid != want:
+                continue
+            snap_fn = getattr(getattr(eng, "batcher", None), "debug_snapshot", None)
+            if snap_fn is not None:
+                engines[mid] = snap_fn()
+        if want is not None and not engines:
+            await self._respond_error(msg, f"model not loaded: {want}")
+            return
+        await self._respond_ok(msg, {"engines": engines})
+
+    async def on_debug_dump(self, msg: Msg) -> None:
+        """debug.dump — force a flight-recorder dump for every loaded engine
+        (or ``{model?}``) and reply with the written paths. The dump
+        directory is always the worker's OBS_DUMP_DIR — a client-supplied
+        path would be an arbitrary-directory-write primitive (same threat
+        model as on_profile's mkdtemp)."""
+        try:
+            req = json.loads(msg.payload) if msg.payload and msg.payload.strip() else {}
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in DebugDump: {e}")
+            return
+        want = (req.get("model") or "").strip() or None
+        paths = {}
+        for mid, eng in self.registry.loaded_engines().items():
+            if want is not None and mid != want:
+                continue
+            recorder = getattr(getattr(eng, "batcher", None), "recorder", None)
+            if recorder is not None:
+                path = recorder.dump("debug_request", force=True,
+                                     extra={"model": mid})
+                if path:
+                    paths[mid] = path
+        if not paths:
+            await self._respond_error(
+                msg,
+                "no dump written (recorder disabled, OBS_DUMP_DIR unset, "
+                "or no engine loaded)",
+            )
+            return
+        await self._respond_ok(msg, {"dumps": paths})

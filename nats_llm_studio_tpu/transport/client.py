@@ -598,8 +598,15 @@ class NatsClient:
         a retryable error) is returned honestly."""
         if retry is None:
             return await self._request_once(subject, payload, timeout, headers)
+        # ONE trace id spans every attempt of a retried request (minted
+        # here, before the attempt loop): the retries are the same logical
+        # request, and a per-attempt id would shatter its story across the
+        # cluster's traces. The attempt header tells the spans apart.
+        headers = dict(headers) if headers else {}
+        headers.setdefault(p.TRACE_HEADER, new_trace_id())
         last_exc: BaseException | None = None
         for attempt in range(1, retry.max_attempts + 1):
+            headers[p.ATTEMPT_HEADER] = str(attempt)
             try:
                 msg = await self._request_once(subject, payload, timeout, headers)
             except ConnectionClosedError as e:

@@ -195,6 +195,12 @@ HDR_PREAMBLE = b"NATS/1.0\r\n"
 # request across every hop without touching the JSON payload
 TRACE_HEADER = "X-Trace-Id"
 
+# retry attempt number (1-based): RetryPolicy keeps ONE trace id across
+# every attempt of a request and stamps this per attempt, so the worker's
+# trace report (and a flight dump's slow-request trace) can tell the
+# attempts of one logical request apart
+ATTEMPT_HEADER = "X-Attempt"
+
 # absolute client deadline in wall-clock milliseconds since the epoch:
 # stamped by request()/request_stream() from the caller's timeout, read by
 # the worker (capped by the per-op ladder) so the serving path can shed or

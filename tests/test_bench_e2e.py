@@ -63,6 +63,25 @@ def test_moe_bench_smoke():
     assert "prefill_4x128" in small["drop_fraction"]
 
 
+def test_obs_overhead_bench_smoke():
+    """The flight-recorder overhead phase must run at tiny scale: both arms
+    measured, the recorder-on arm actually sampled frames, and the noise-
+    floor-guarded overhead bound held (the phase asserts it internally)."""
+    import bench
+    from nats_llm_studio_tpu.models.config import ModelConfig
+    from nats_llm_studio_tpu.models.llama import ensure_lm_head, init_params
+
+    cfg = ModelConfig.tiny(vocab_size=300, n_layers=2, max_seq_len=256)
+    params = ensure_lm_head(init_params(cfg, jax.random.PRNGKey(0)))
+    out = bench.obs_overhead_bench(
+        cfg, params, seq=128, slots=2, n_reqs=2, max_new=12, rounds=2
+    )
+    assert out["frames_sampled"] > 0
+    assert len(out["off_tok_s"]) == 2 and len(out["on_tok_s"]) == 2
+    assert out["off_median_tok_s"] > 0 and out["on_median_tok_s"] > 0
+    assert out["overhead_pct"] < max(1.0, out["noise_floor_pct"])
+
+
 def test_e2e_long_context_bench_smoke(monkeypatch):
     """The long-context serving wave (VERDICT r3 missing #1) at tiny scale:
     real prompt_tokens come back from usage, interference gaps and

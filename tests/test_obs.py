@@ -204,6 +204,138 @@ def test_prom_renderer_families_and_histogram_exposition():
         r.gauge("app_requests_total", 1)  # type conflict on one family
 
 
+# -- compile-cache counters --------------------------------------------------
+
+
+def test_compile_cache_listener_install_idempotent():
+    from nats_llm_studio_tpu.obs import compile_cache as cc
+
+    assert cc.install_compile_cache_listener() is True
+    # a second (and third) install is a no-op, not a second registration —
+    # otherwise every event would double-count
+    assert cc.install_compile_cache_listener() is True
+    assert cc.install_compile_cache_listener() is True
+
+
+def test_compile_cache_counts_accumulate_and_snapshot_is_a_copy():
+    from nats_llm_studio_tpu.obs import compile_cache as cc
+
+    before = cc.compile_cache_counts()
+    cc._on_event("/jax/compilation_cache/cache_hits")
+    cc._on_event("/jax/compilation_cache/cache_hits")
+    cc._on_event("/jax/compilation_cache/cache_misses")
+    cc._on_event("/jax/unrelated/event")  # ignored, not a KeyError
+    after = cc.compile_cache_counts()
+    assert after["hits"] - before["hits"] == 2
+    assert after["misses"] - before["misses"] == 1
+    after["hits"] = -999  # mutating the snapshot must not touch the counters
+    assert cc.compile_cache_counts()["hits"] >= 0
+
+
+# -- strict exposition check (minimal line parser) ---------------------------
+
+
+def check_prom_exposition(text: str) -> dict:
+    """Minimal Prometheus text-exposition validator: every sample line
+    parses, every family has exactly ONE # TYPE line, every sample belongs
+    to a declared family, and every histogram's ``_bucket`` series is
+    cumulative-monotone per label set with a ``+Inf`` bucket equal to its
+    ``_count``, with ``_sum``/``_count`` present. Returns {family: type}."""
+    import re
+
+    typed: dict[str, str] = {}
+    samples: dict[str, list] = {}
+    sample_re = re.compile(
+        r"([a-zA-Z_:][a-zA-Z0-9_:]*)"        # metric name
+        r"(?:\{(.*)\})?"                      # optional label set
+        r" (-?(?:[0-9.]+(?:e[+-]?[0-9]+)?|Inf)|\+Inf|NaN)$"
+    )
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for ln in text.splitlines():
+        if not ln or ln.startswith("# HELP"):
+            continue
+        if ln.startswith("# TYPE"):
+            _, _, fam, typ = ln.split()
+            assert fam not in typed, f"duplicate TYPE line for {fam}"
+            assert typ in ("counter", "gauge", "histogram"), ln
+            typed[fam] = typ
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln!r}"
+        m = sample_re.fullmatch(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name, labelstr, _val = m.groups()
+        labels = dict(label_re.findall(labelstr)) if labelstr else {}
+        samples.setdefault(name, []).append((labels, float(m.group(3))))
+    for name in samples:
+        base = name
+        for suf in ("_bucket", "_sum", "_count"):
+            stripped = name[: -len(suf)]
+            if name.endswith(suf) and typed.get(stripped) == "histogram":
+                base = stripped
+        assert base in typed, f"sample {name} has no TYPE line"
+    for fam, typ in typed.items():
+        if typ != "histogram":
+            continue
+        by_series: dict[tuple, list] = {}
+        for labels, val in samples.get(fam + "_bucket", []):
+            assert "le" in labels, f"{fam} bucket without le: {labels}"
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            by_series.setdefault(key, []).append((float(labels["le"]), val))
+        counts = {tuple(sorted(l.items())): v
+                  for l, v in samples.get(fam + "_count", [])}
+        sums = {tuple(sorted(l.items())): v
+                for l, v in samples.get(fam + "_sum", [])}
+        assert by_series, f"histogram {fam} exposes no buckets"
+        for key, series in by_series.items():
+            series.sort()
+            les = [le for le, _ in series]
+            assert les[-1] == float("inf"), f"{fam}{key} missing +Inf bucket"
+            assert len(set(les)) == len(les), f"{fam}{key} duplicate le"
+            cums = [c for _, c in series]
+            assert all(b >= a for a, b in zip(cums, cums[1:])), (
+                f"{fam}{key} buckets not cumulative-monotone: {series}"
+            )
+            assert key in counts, f"{fam}{key} missing _count"
+            assert key in sums, f"{fam}{key} missing _sum"
+            assert cums[-1] == counts[key], (
+                f"{fam}{key} +Inf bucket != _count"
+            )
+    return typed
+
+
+def test_exposition_checker_accepts_renderer_output_and_rejects_bad():
+    import pytest
+
+    h = LogHistogram(lo=1.0, hi=8.0, growth=2.0)
+    for v in (0.5, 3.0, 100.0):
+        h.record(v)
+    r = PromRenderer()
+    r.counter("x_total", 1, labels={"model": "a"})
+    r.counter("x_total", 2, labels={"model": "b"})
+    r.histogram("y_ms", h.snapshot(), labels={"model": "a"})
+    r.histogram("y_ms", h.snapshot(), labels={"model": "b"})
+    typed = check_prom_exposition(r.render())
+    assert typed == {"x_total": "counter", "y_ms": "histogram"}
+
+    with pytest.raises(AssertionError, match="duplicate TYPE"):
+        check_prom_exposition(
+            "# TYPE a counter\na 1\n# TYPE a counter\na 2\n"
+        )
+    with pytest.raises(AssertionError, match="no TYPE line"):
+        check_prom_exposition("orphan_metric 3\n")
+    with pytest.raises(AssertionError, match="cumulative"):
+        check_prom_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\nh_bucket{le="+Inf"} 5\n'
+            "h_sum 9\nh_count 5\n"
+        )
+    with pytest.raises(AssertionError, match="missing _count"):
+        check_prom_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 2\nh_sum 3\n'
+        )
+
+
 # -- end-to-end: trace + metrics.prom + events over the wire -----------------
 
 
@@ -289,6 +421,20 @@ async def test_trace_and_metrics_e2e_over_embedded_broker(tmp_path):
         assert 'lmstudio_admit_queue_delay_ms_count{model="acme/obs"} 2' in text
         assert "# TYPE lmstudio_requests_total counter" in text
         assert "lmstudio_batcher_requests_total" in text
+        # per-program device timing: one labeled histogram family over every
+        # jit-grid program dispatched, plus tokens per dispatch
+        assert text.count("# TYPE lmstudio_program_ms histogram") == 1
+        assert text.count("# TYPE lmstudio_program_tokens histogram") == 1
+        program_counts = [
+            ln for ln in text.splitlines()
+            if ln.startswith("lmstudio_program_ms_count{")
+        ]
+        assert program_counts and all('program="' in ln for ln in program_counts)
+        assert len(program_counts) >= 2  # admit + decode at minimum
+        # the whole exposition is STRICTLY valid: one TYPE line per family,
+        # cumulative-monotone buckets, _sum/_count per histogram series
+        typed = check_prom_exposition(text)
+        assert typed["lmstudio_program_ms"] == "histogram"
 
         # the event ring saw the engine load; the subject serves it
         resp = await h.req("events", {"kind": "engine_load"})

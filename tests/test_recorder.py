@@ -1,0 +1,392 @@
+"""Flight recorder + deep debug subjects + retry trace propagation (PR 8).
+
+Unit coverage for obs/recorder.py (ring, interval, windowed dumps, rate
+limiting), the acceptance flow — a chaos pump crash must leave a flight
+dump whose frames carry the pre-crash queue depth and whose event tail
+contains the restart — and the DEBUG_SUBJECTS surface
+(lmstudio.debug.snapshot / lmstudio.debug.dump), including agreement
+between the snapshot's pool view and the lmstudio_kv_pool_* gauges.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.obs import EVENTS, FlightRecorder
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.registry import LocalRegistry
+from nats_llm_studio_tpu.store.manager import ModelStore
+from nats_llm_studio_tpu.transport import (
+    EmbeddedBroker,
+    RetryPolicy,
+    connect,
+    envelope_error,
+    envelope_ok,
+)
+from nats_llm_studio_tpu.transport import faults
+
+from conftest import async_test
+from fakes import FakeRegistry
+from test_faults import MID, _chat_body, _publish_tiny, _wait_for
+
+
+# -- FlightRecorder units ----------------------------------------------------
+
+
+def test_ring_capacity_oldest_first_and_counters():
+    rec = FlightRecorder(capacity=4, interval_ms=1.0)
+    for i in range(6):
+        rec.sample({"i": i})
+    assert rec.frames_sampled == 6
+    assert [f["i"] for f in rec.frames()] == [2, 3, 4, 5]
+    assert [f["i"] for f in rec.tail(2)] == [4, 5]
+    # every frame is stamped with wall + monotonic time
+    assert all("ts" in f and "mono" in f for f in rec.frames())
+
+
+def test_due_respects_interval():
+    rec = FlightRecorder(interval_ms=1000.0)
+    assert rec.due(now=100.0)  # nothing sampled yet
+    rec.sample({"a": 1}, now=100.0)
+    assert not rec.due(now=100.5)
+    assert rec.due(now=101.0)
+
+
+def test_disabled_recorder_is_inert(tmp_path):
+    rec = FlightRecorder(enabled=False, dump_dir=str(tmp_path))
+    assert not rec.due()
+    rec.sample({"a": 1})
+    assert rec.frames_sampled == 0 and rec.frames() == []
+    assert rec.dump("anything", force=True) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_frames_window_by_monotonic_stamp():
+    rec = FlightRecorder(interval_ms=1.0)
+    for i in range(5):
+        rec.sample({"i": i}, now=100.0 + i)  # mono 100..104
+    win = rec.frames(last_s=2.5)  # cutoff 104 - 2.5 = 101.5
+    assert [f["i"] for f in win] == [2, 3, 4]
+
+
+def test_counter_fns_merged_and_exceptions_swallowed():
+    def boom():
+        raise RuntimeError("nope")
+
+    rec = FlightRecorder(interval_ms=1.0,
+                         counter_fns={"good": lambda: 7, "bad": boom})
+    rec.sample({"queue_depth": 3})
+    (fr,) = rec.frames()
+    assert fr["good"] == 7 and fr["queue_depth"] == 3
+    assert "bad" not in fr
+
+
+def test_dump_writes_json_rate_limits_and_force(tmp_path):
+    rec = FlightRecorder(interval_ms=1.0, dump_dir=str(tmp_path),
+                         engine="acme/x", dump_min_interval_s=60.0)
+    for i in range(3):
+        rec.sample({"i": i})
+    EVENTS.emit("unit_marker", n=1)
+    path = rec.dump("kv_pool_exhausted", trace={"trace_id": "t1"},
+                    extra={"needed": 2})
+    assert path is not None
+    doc = json.loads(open(path).read())
+    assert doc["reason"] == "kv_pool_exhausted"
+    assert doc["engine"] == "acme/x"
+    assert [f["i"] for f in doc["frames"]] == [0, 1, 2]
+    assert doc["trace"] == {"trace_id": "t1"}
+    assert doc["extra"] == {"needed": 2}
+    assert any(e["kind"] == "unit_marker" for e in doc["events"])
+    # the dump itself is announced on the event ring
+    assert any(e["kind"] == "flight_dump" and e["path"] == path
+               for e in EVENTS.snapshot(limit=8))
+    # within the min interval: suppressed...
+    assert rec.dump("kv_pool_exhausted") is None
+    # ...unless forced (restart/operator dumps must always land)
+    assert rec.dump("engine_restart", force=True) is not None
+    assert rec.dumps_written == 2
+    assert len(list(tmp_path.glob("flight-*.json"))) == 2
+
+
+def test_dump_without_dir_returns_none():
+    rec = FlightRecorder(interval_ms=1.0)
+    rec.sample({"a": 1})
+    assert rec.dump("x", force=True) is None
+
+
+# -- acceptance: chaos pump crash leaves a usable flight dump ----------------
+
+
+@async_test
+async def test_pump_crash_produces_flight_dump_with_precrash_frames(tmp_path):
+    """ISSUE 8 acceptance: crash the pump via the chaos harness, let the
+    supervisor restart the engine, then assert the engine_restart dump
+    exists, its frames carry the pre-crash queue depth, and its event tail
+    contains the restart."""
+    models = tmp_path / "models"
+    dumps = tmp_path / "dumps"
+    _publish_tiny(models)
+    broker = await EmbeddedBroker().start()
+    try:
+        reg = LocalRegistry(
+            ModelStore(models), dtype="float32", max_batch_slots=2,
+            max_seq_len=64, restart_backoff_s=0.05, restart_backoff_max_s=0.2,
+            max_restarts=10, restart_window_s=60.0,
+            obs_recorder=True, obs_recorder_interval_ms=5.0,
+            obs_dump_dir=str(dumps),
+        )
+        worker = Worker(
+            WorkerConfig(nats_url=broker.url, supervise_interval_s=0.05,
+                         engine_heartbeat_timeout_s=0.0),
+            reg,
+        )
+        await worker.start()
+        nc = await connect(broker.url)
+        env = json.loads(
+            (await nc.request("lmstudio.chat_model", _chat_body("warmup"),
+                              timeout=60)).payload
+        )
+        assert env["ok"] is True, env
+        eng = await reg.get_engine(MID)
+        rec = eng.batcher.recorder
+        assert rec is not None and rec.frames_sampled > 0
+        # worker-level counters ride every frame via recorder_counters
+        assert "engine_restarts" in rec.tail(1)[0]
+        assert "reconnects" in rec.tail(1)[0]
+
+        faults.install(faults.FaultPlan().raise_at(faults.PUMP, 0,
+                                                   message="chaos crash"))
+        try:
+            env = json.loads(
+                (await nc.request("lmstudio.chat_model",
+                                  _chat_body("victim", max_tokens=40),
+                                  timeout=30)).payload
+            )
+            assert env["ok"] is False and env["retryable"] is True, env
+            await _wait_for(lambda: reg.engine_restarts_total >= 1,
+                            what="supervisor engine restart")
+            await _wait_for(
+                lambda: list(dumps.glob("flight-*-engine_restart.json")),
+                what="engine_restart flight dump",
+            )
+        finally:
+            faults.clear()
+
+        (path,) = dumps.glob("flight-*-engine_restart.json")
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "engine_restart"
+        assert doc["engine"] == MID
+        assert doc["extra"]["restart_reason"]
+        # pre-crash frames made it into the dump, each with queue depth
+        assert doc["frames"], "dump has no pre-crash frames"
+        assert all("queue_depth" in fr for fr in doc["frames"])
+        assert all("active_slots" in fr for fr in doc["frames"])
+        # the event tail contains the restart itself
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "engine_restart" in kinds
+        assert "engine_crash" in kinds
+        # the crash dump (rate-limit class, unforced) landed too
+        assert list(dumps.glob("flight-*-engine_crash.json"))
+
+        # the restarted engine serves again, with a fresh recorder
+        env = json.loads(
+            (await nc.request(
+                "lmstudio.chat_model", _chat_body("after restart"), timeout=60,
+                retry=RetryPolicy(max_attempts=10, backoff_s=0.05),
+            )).payload
+        )
+        assert env["ok"] is True, env
+        eng2 = await reg.get_engine(MID)
+        assert eng2.batcher.recorder is not rec
+        await nc.close()
+        await worker.drain()
+    finally:
+        await broker.stop()
+
+
+# -- debug subjects ----------------------------------------------------------
+
+
+@async_test
+async def test_debug_snapshot_and_dump_subjects(tmp_path):
+    models = tmp_path / "models"
+    dumps = tmp_path / "dumps"
+    _publish_tiny(models)
+    broker = await EmbeddedBroker().start()
+    try:
+        reg = LocalRegistry(
+            ModelStore(models), dtype="float32", max_batch_slots=2,
+            max_seq_len=64, obs_recorder=True, obs_recorder_interval_ms=5.0,
+            obs_dump_dir=str(dumps),
+        )
+        worker = Worker(
+            WorkerConfig(nats_url=broker.url, debug_subjects=True), reg
+        )
+        await worker.start()
+        nc = await connect(broker.url)
+
+        async def req(op, payload):
+            msg = await nc.request(f"lmstudio.{op}",
+                                   json.dumps(payload).encode(), timeout=30)
+            return json.loads(msg.payload)
+
+        env = json.loads(
+            (await nc.request("lmstudio.chat_model", _chat_body("warm"),
+                              timeout=60)).payload
+        )
+        assert env["ok"] is True, env
+        eng = await reg.get_engine(MID)
+
+        # snapshot with a slot mid-decode: the slot table shows the live
+        # request's position and (paged) block table with refcounts
+        blocker = asyncio.ensure_future(
+            nc.request("lmstudio.chat_model",
+                       _chat_body("blocker", max_tokens=40), timeout=60)
+        )
+        await _wait_for(lambda: any(s is not None for s in eng.batcher._slots),
+                        what="blocker admitted")
+        resp = await req("debug.snapshot", {})
+        assert resp["ok"], resp
+        snap = resp["data"]["engines"][MID]
+        assert snap["max_slots"] == 2 and snap["queue_depth"] >= 0
+        await _wait_for(
+            lambda: eng.batcher.debug_snapshot()["slots"],
+            what="slot visible in the debug view",
+        )
+        live = eng.batcher.debug_snapshot()
+        (slot,) = live["slots"].values()
+        assert slot["pos"] >= 1 and slot["max_tokens"] == 40
+        if live["paged"]:
+            assert slot["blocks"]
+            assert len(slot["block_refcounts"]) == len(slot["blocks"])
+            assert all(rc >= 1 for rc in slot["block_refcounts"])
+        assert (await blocker).payload  # finish the blocker
+
+        # snapshot's pool view agrees with the lmstudio_kv_pool_* gauges
+        # scraped at the same (idle) instant
+        snap = (await req("debug.snapshot", {"model": MID}))["data"]["engines"][MID]
+        prom = (await nc.request("lmstudio.metrics.prom", b"",
+                                 timeout=10)).payload.decode()
+        if "pool" in snap:
+            gauges = {}
+            for ln in prom.splitlines():
+                if ln.startswith("lmstudio_kv_pool_blocks"):
+                    name = ln.split("{")[0]
+                    gauges[name] = float(ln.rsplit(" ", 1)[1])
+            assert gauges["lmstudio_kv_pool_blocks_total"] == snap["pool"]["blocks_total"]
+            assert gauges["lmstudio_kv_pool_blocks_free"] == snap["pool"]["blocks_free"]
+            assert gauges["lmstudio_kv_pool_blocks_shared"] == snap["pool"]["blocks_shared"]
+        # recorder surface rides the snapshot
+        assert snap["recorder_frames_sampled"] > 0
+        assert snap["recorder_tail"]
+
+        # unknown model → error envelope
+        resp = await req("debug.snapshot", {"model": "acme/nope"})
+        assert not resp["ok"] and "not loaded" in resp["error"]
+
+        # forced dump replies with the written path
+        resp = await req("debug.dump", {})
+        assert resp["ok"], resp
+        path = resp["data"]["dumps"][MID]
+        doc = json.loads(open(path).read())
+        assert doc["reason"] == "debug_request"
+        # model filter misses → honest error, no file
+        resp = await req("debug.dump", {"model": "acme/nope"})
+        assert not resp["ok"] and "no dump written" in resp["error"]
+
+        await nc.close()
+        await worker.drain()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_debug_subjects_absent_by_default():
+    """DEBUG_SUBJECTS off (the default): the subjects are never subscribed,
+    so a request simply finds no responder."""
+    broker = await EmbeddedBroker().start()
+    try:
+        worker = Worker(WorkerConfig(nats_url=broker.url), FakeRegistry())
+        await worker.start()
+        nc = await connect(broker.url)
+        for op in ("debug.snapshot", "debug.dump"):
+            with pytest.raises(asyncio.TimeoutError):
+                await nc.request(f"lmstudio.{op}", b"{}", timeout=0.4)
+        await nc.close()
+        await worker.drain()
+    finally:
+        await broker.stop()
+
+
+# -- retry trace propagation (satellite 3) -----------------------------------
+
+
+@async_test
+async def test_retry_keeps_one_trace_id_with_attempt_tags():
+    """RetryPolicy re-issues carry the SAME X-Trace-Id with 1-based
+    X-Attempt tags, so the attempts of one logical request share a story."""
+    broker = await EmbeddedBroker().start()
+    try:
+        server = await connect(broker.url)
+        seen: list[tuple[str, str]] = []
+
+        async def handler(msg):
+            h = msg.headers or {}
+            seen.append((h.get("X-Trace-Id", ""), h.get("X-Attempt", "")))
+            if len(seen) < 3:
+                await msg.respond(envelope_error("busy", retryable=True))
+            else:
+                await msg.respond(envelope_ok({"served": len(seen)}))
+
+        await server.subscribe("svc.flaky", cb=handler)
+        await server.flush()
+
+        nc = await connect(broker.url)
+        msg = await nc.request(
+            "svc.flaky", b"", timeout=5,
+            retry=RetryPolicy(max_attempts=5, backoff_s=0.01),
+        )
+        env = json.loads(msg.payload)
+        assert env["ok"] and env["data"]["served"] == 3
+        assert len(seen) == 3
+        assert len({tid for tid, _ in seen}) == 1, seen  # one trace id
+        assert seen[0][0]  # and it is non-empty
+        assert [a for _, a in seen] == ["1", "2", "3"]
+        await nc.close()
+        await server.close()
+    finally:
+        await broker.stop()
+
+
+@async_test
+async def test_worker_trace_report_carries_attempt():
+    """The worker reads X-Attempt into the Trace, and the response's trace
+    report says which attempt of the logical request finally succeeded."""
+    broker = await EmbeddedBroker().start()
+    try:
+        worker = Worker(WorkerConfig(nats_url=broker.url), FakeRegistry())
+        await worker.start()
+        nc = await connect(broker.url)
+        body = json.dumps({
+            "model": "fake-echo-1",
+            "messages": [{"role": "user", "content": "hi"}],
+        }).encode()
+        msg = await nc.request(
+            "lmstudio.chat_model", body, timeout=10,
+            headers={"X-Trace-Id": "feedfacefeedface", "X-Attempt": "3"},
+        )
+        env = json.loads(msg.payload)
+        assert env["ok"], env
+        rep = env["data"]["response"]["stats"]["trace"]
+        assert rep["trace_id"] == "feedfacefeedface"
+        assert rep["attempt"] == 3
+        # untagged requests stay attempt-free (shape unchanged)
+        msg = await nc.request("lmstudio.chat_model", body, timeout=10)
+        rep = json.loads(msg.payload)["data"]["response"]["stats"]["trace"]
+        assert "attempt" not in rep
+        await nc.close()
+        await worker.drain()
+    finally:
+        await broker.stop()
