@@ -1819,32 +1819,18 @@ def obs_overhead_bench(cfg, params, *, seq: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-def chaos_bench() -> dict:
-    """Fault-injected serving (transport/faults.py): a seeded FaultPlan
-    severs the client's broker connection mid-run AND crashes the engine
-    pump loop once. Every request must still complete — auto-reconnect +
-    request retry on the client, supervisor engine restart on the worker.
-    Reports recovery behavior (reconnects, restarts, restart latency, total
-    wall time), not throughput; runs a tiny model so the phase measures the
-    resilience machinery, not XLA."""
-    import asyncio
-    import tempfile
+def _export_tiny_gguf(models_dir, mid: str, seed: int = 5) -> None:
+    """Export a 2-layer tiny model with a byte-level gpt2 tokenizer to
+    ``models_dir/mid/m.gguf`` — the resilience phases (chaos, cluster) run
+    it so they measure the recovery machinery, not XLA."""
     from pathlib import Path
 
-    from nats_llm_studio_tpu.config import WorkerConfig
     from nats_llm_studio_tpu.gguf.constants import TokenType
     from nats_llm_studio_tpu.gguf.tokenizer import _byte_to_unicode
     from nats_llm_studio_tpu.models.export import export_params_to_gguf
-    from nats_llm_studio_tpu.serve import Worker
-    from nats_llm_studio_tpu.serve.registry import LocalRegistry
-    from nats_llm_studio_tpu.store.manager import ModelStore
-    from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
-    from nats_llm_studio_tpu.transport import faults
 
-    mid = "bench/chaos-tiny"
-    n_reqs = int(os.environ.get("BENCH_CHAOS_REQS", "8"))
     tcfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
-    tparams = init_params(tcfg, jax.random.PRNGKey(5))
+    tparams = init_params(tcfg, jax.random.PRNGKey(seed))
     b2u = _byte_to_unicode()
     tokens = [b2u[b] for b in range(256)]
     while len(tokens) < tcfg.vocab_size - 1:
@@ -1861,12 +1847,36 @@ def chaos_bench() -> dict:
         "tokenizer.ggml.eos_token_id": tcfg.vocab_size - 1,
         "tokenizer.ggml.add_bos_token": False,
     }
+    d = Path(models_dir) / mid
+    d.mkdir(parents=True)
+    export_params_to_gguf(d / "m.gguf", tparams, tcfg, name=mid,
+                          tokenizer_md=tok_md)
+
+
+def chaos_bench() -> dict:
+    """Fault-injected serving (transport/faults.py): a seeded FaultPlan
+    severs the client's broker connection mid-run AND crashes the engine
+    pump loop once. Every request must still complete — auto-reconnect +
+    request retry on the client, supervisor engine restart on the worker.
+    Reports recovery behavior (reconnects, restarts, restart latency, total
+    wall time), not throughput; runs a tiny model so the phase measures the
+    resilience machinery, not XLA."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.store.manager import ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+    from nats_llm_studio_tpu.transport import faults
+
+    mid = "bench/chaos-tiny"
+    n_reqs = int(os.environ.get("BENCH_CHAOS_REQS", "8"))
 
     async def run(models_dir: Path) -> dict:
-        d = models_dir / mid
-        d.mkdir(parents=True)
-        export_params_to_gguf(d / "m.gguf", tparams, tcfg, name=mid,
-                              tokenizer_md=tok_md)
+        _export_tiny_gguf(models_dir, mid)
         broker = await EmbeddedBroker().start()
         registry = LocalRegistry(
             ModelStore(models_dir), dtype="float32", max_batch_slots=2,
@@ -1941,6 +1951,238 @@ def chaos_bench() -> dict:
         await worker.drain()
         await broker.stop()
         return out
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(run(Path(td) / "models"))
+
+
+def cluster_bench(*, n_workers: int | None = None, n_clients: int | None = None,
+                  reqs_per_client: int | None = None,
+                  max_new: int | None = None) -> dict:
+    """Multi-worker failover (serve/router.py + ISSUE 10 chaos): N workers
+    share the queue group on one embedded broker; a worker-scoped sever
+    rule (faults.sever_worker) kills one mid-overload-wave, with
+    auto-reconnect disabled so the kill is permanent — its queue subs die
+    with the connection and the broker routes every later request to the
+    survivors. Acceptance: every request is served or fails with a
+    *cleanly retryable* envelope — zero client-side timeout expiries — and
+    no retry is ever SERVED by a worker named in its own
+    X-Excluded-Workers header (the worker self-check bounces those hops;
+    the per-worker prom counters in the output are the evidence). Reports
+    aggregate tok/s and server-side p95 TTFT (merged per-worker
+    lmstudio_ttft_ms histograms) for the cluster wave vs a single-worker
+    baseline wave."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.store.manager import ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+    from nats_llm_studio_tpu.transport import faults
+    from nats_llm_studio_tpu.transport import protocol as proto
+    from nats_llm_studio_tpu.transport.envelope import deadline_header_value
+
+    mid = "bench/cluster-tiny"
+    n_workers = n_workers or int(os.environ.get("BENCH_CLUSTER_WORKERS", "2"))
+    n_clients = n_clients or int(os.environ.get("BENCH_CLUSTER_CLIENTS", "144"))
+    reqs = reqs_per_client or int(os.environ.get("BENCH_CLUSTER_REQS", "1"))
+    max_new = max_new or int(os.environ.get("BENCH_CLUSTER_NEW", "8"))
+    slots = int(os.environ.get("BENCH_CLUSTER_SLOTS", "4"))
+    attempt_s = float(os.environ.get("BENCH_CLUSTER_ATTEMPT_TIMEOUT_S", "8"))
+    budget_s = float(os.environ.get("BENCH_CLUSTER_BUDGET_S", "90"))
+    kill_step = int(os.environ.get("BENCH_CLUSTER_KILL_STEP",
+                                   str(max(4, n_clients // 4))))
+
+    def prom_sum(text: str, family: str) -> float:
+        return sum(
+            float(line.rsplit(None, 1)[1])
+            for line in text.splitlines()
+            if line.startswith(family + "{") or line.startswith(family + " ")
+        )
+
+    def ttft_p95(prom_texts: list[str]) -> float:
+        """p95 from the merged cumulative lmstudio_ttft_ms buckets (upper
+        bucket edge — resolution-honest, no interpolation)."""
+        edges: dict[str, float] = {}
+        for text in prom_texts:
+            for line in text.splitlines():
+                if not line.startswith("lmstudio_ttft_ms_bucket"):
+                    continue
+                i = line.index('le="') + 4
+                le = line[i:line.index('"', i)]
+                edges[le] = edges.get(le, 0.0) + float(line.rsplit(None, 1)[1])
+        pairs = sorted(
+            (float("inf") if le == "+Inf" else float(le), c)
+            for le, c in edges.items()
+        )
+        total = pairs[-1][1] if pairs else 0.0
+        if total <= 0:
+            return 0.0
+        for le, c in pairs:
+            if c >= 0.95 * total and le != float("inf"):
+                return le
+        return pairs[-2][0] if len(pairs) > 1 else 0.0
+
+    async def spawn(broker, models_dir: Path, wid: str):
+        registry = LocalRegistry(
+            ModelStore(models_dir), dtype="float32", max_batch_slots=slots,
+            max_seq_len=64, restart_backoff_s=0.05, restart_backoff_max_s=0.2,
+            max_restarts=10, restart_window_s=60.0, worker_id=wid,
+        )
+        worker = Worker(
+            WorkerConfig(
+                nats_url=broker.url, worker_id=wid,
+                cluster_advert_interval_s=0.2,
+                supervise_interval_s=0.1, engine_heartbeat_timeout_s=0.0,
+                # the kill must be permanent: a severed worker stays dead
+                max_reconnects=0,
+            ),
+            registry,
+        )
+        await worker.start()
+        return worker
+
+    def body_for(tag: str) -> bytes:
+        return json.dumps({
+            "model": mid,
+            "messages": [{"role": "user", "content": f"cluster probe {tag}"}],
+            "max_tokens": max_new, "temperature": 0.0, "stream": False,
+        }).encode()
+
+    async def wave(nc, tag: str) -> dict:
+        out = {"served": 0, "retryable": 0, "hard_failed": 0, "timeouts": 0,
+               "tokens": 0}
+        lat: list[float] = []
+        retry = RetryPolicy(max_attempts=20, backoff_s=0.05, max_backoff_s=0.5,
+                            retry_on_timeout=True)
+
+        async def client(i: int) -> None:
+            for r_i in range(reqs):
+                # explicit wall budget + short per-attempt timeout: an
+                # attempt stuck on the killed worker times out quickly and
+                # rehops (through the exclusion header) inside the budget
+                headers = {proto.DEADLINE_HEADER: deadline_header_value(budget_s)}
+                t0 = time.perf_counter()
+                try:
+                    msg = await nc.request(
+                        "lmstudio.chat_model", body_for(f"{tag} c{i} r{r_i}"),
+                        timeout=attempt_s, headers=headers, retry=retry,
+                    )
+                except asyncio.TimeoutError:
+                    out["timeouts"] += 1
+                    continue
+                r = json.loads(msg.payload)
+                lat.append(time.perf_counter() - t0)
+                if r.get("ok"):
+                    out["served"] += 1
+                    usage = (r["data"]["response"].get("usage") or {})
+                    out["tokens"] += int(usage.get("completion_tokens", 0))
+                elif r.get("retryable"):
+                    out["retryable"] += 1
+                else:
+                    out["hard_failed"] += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[client(i) for i in range(n_clients)])
+        wall = time.perf_counter() - t0
+        out["wall_s"] = round(wall, 3)
+        out["tok_s"] = round(out["tokens"] / wall, 1) if wall > 0 else 0.0
+        lat.sort()
+        out["p95_latency_ms"] = round(1000 * _pctl(lat, 0.95), 1) if lat else 0.0
+        return out
+
+    async def scrape(nc, wid: str) -> str:
+        msg = await nc.request(f"lmstudio.worker.{wid}.metrics.prom", b"",
+                               timeout=10)
+        return msg.payload.decode()
+
+    async def run(models_dir: Path) -> dict:
+        _export_tiny_gguf(models_dir, mid)
+
+        # -- baseline: the same wave against ONE worker ----------------------
+        broker = await EmbeddedBroker().start()
+        worker = await spawn(broker, models_dir, "w-base")
+        nc = await connect(broker.url, reconnect_wait_s=0.02,
+                           reconnect_max_wait_s=0.2)
+        warm = json.loads(
+            (await nc.request("lmstudio.chat_model", body_for("warm"),
+                              timeout=120)).payload
+        )
+        assert warm.get("ok"), warm
+        single = await wave(nc, "single")
+        single["ttft_p95_ms"] = ttft_p95([await scrape(nc, "w-base")])
+        await nc.close()
+        await worker.drain()
+        await broker.stop()
+
+        # -- cluster: N workers, one killed mid-wave -------------------------
+        broker = await EmbeddedBroker().start()
+        wids = [f"w-{i}" for i in range(n_workers)]
+        workers = [await spawn(broker, models_dir, wid) for wid in wids]
+        nc = await connect(broker.url, reconnect_wait_s=0.02,
+                           reconnect_max_wait_s=0.2)
+        for wid in wids:
+            # warm every engine through its directed subject so fault steps
+            # land in the measured wave, not the initial load
+            warm = json.loads(
+                (await nc.request(f"lmstudio.worker.{wid}.chat_model",
+                                  body_for(f"warm {wid}"), timeout=120)).payload
+            )
+            assert warm.get("ok"), warm
+        victim = wids[0]
+        plan = faults.install(
+            faults.FaultPlan(seed=int(os.environ.get("BENCH_CLUSTER_SEED", "11")))
+            .sever_worker(victim, kill_step)
+        )
+        try:
+            cluster = await wave(nc, "cluster")
+        finally:
+            faults.clear()
+        survivors = {}
+        prom_texts = []
+        for wid in wids[1:]:
+            text = await scrape(nc, wid)
+            prom_texts.append(text)
+            survivors[wid] = {
+                "requests_total": prom_sum(text, "lmstudio_requests_total"),
+                "excluded_bounce_total": prom_sum(
+                    text, "lmstudio_excluded_bounce_total"),
+                "drain_bounce_total": prom_sum(
+                    text, "lmstudio_drain_bounce_total"),
+                "reconnects_total": prom_sum(text, "lmstudio_reconnects_total"),
+            }
+        cluster["ttft_p95_ms"] = ttft_p95(prom_texts)
+        total = n_clients * reqs
+        cluster["all_served_or_retryable"] = (
+            cluster["timeouts"] == 0 and cluster["hard_failed"] == 0
+            and cluster["served"] + cluster["retryable"] == total
+        )
+        await nc.close()
+        for w in workers:
+            try:
+                await w.drain()
+            except (ConnectionError, asyncio.TimeoutError):
+                pass  # the victim's connection is (deliberately) dead
+        await broker.stop()
+        return {
+            "workers": n_workers,
+            "clients": n_clients,
+            "reqs_per_client": reqs,
+            "victim": victim,
+            "kill_step": kill_step,
+            "worker_killed": plan.done(),
+            "faults_fired": plan.fired(),
+            "single": single,
+            "cluster": cluster,
+            "survivor_counters": survivors,
+            "cluster_vs_single_tok_s": (
+                round(cluster["tok_s"] / single["tok_s"], 3)
+                if single["tok_s"] else 0.0
+            ),
+        }
 
     with tempfile.TemporaryDirectory() as td:
         return asyncio.run(run(Path(td) / "models"))
@@ -2123,6 +2365,13 @@ def main() -> None:
         if os.environ.get("BENCH_CHAOS", "1") != "0":
             # fault-injected serving: recovery must hold in CI smoke too
             _run_phase(tiny_detail, "chaos", chaos_bench)
+        if os.environ.get("BENCH_CLUSTER", "1") != "0":
+            # micro-run of the multi-worker failover phase: two workers,
+            # one killed mid-wave — every request served or cleanly
+            # retryable (CI smoke asserts the flag on the final line)
+            _run_phase(tiny_detail, "cluster", lambda: cluster_bench(
+                n_workers=2, n_clients=12, reqs_per_client=2, max_new=8,
+            ))
         _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
@@ -2241,6 +2490,11 @@ def main() -> None:
     # -- chaos: fault-injected serving recovery (own tiny model) -------------
     if os.environ.get("BENCH_CHAOS", "1") != "0":
         _run_phase(detail, "chaos", chaos_bench)
+        gc.collect()
+
+    # -- cluster: kill-a-worker failover under overload (own tiny model) -----
+    if os.environ.get("BENCH_CLUSTER", "1") != "0":
+        _run_phase(detail, "cluster", cluster_bench)
         gc.collect()
 
     del params

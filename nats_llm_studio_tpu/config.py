@@ -210,6 +210,33 @@ class WorkerConfig:
         default_factory=lambda: _env("DEBUG_SUBJECTS", "0").strip().lower()
         in ("1", "true", "on")
     )
+    # -- cluster membership + failover routing (serve/router.py) -------------
+    # stable cluster identity: stamped on every reply (X-Worker-Id), in
+    # adverts, prom labels, recorder frames, and the CONNECT name
+    # (tpu-worker-<id> — the chaos harness's worker-scoped kill switch keys
+    # on it). Empty WORKER_ID derives a short random id at startup.
+    worker_id: str = field(default_factory=lambda: _env("WORKER_ID", ""))
+    # period between lmstudio.cluster.adverts publishes; 0 disables the
+    # advert loop (single-worker deployments lose nothing)
+    cluster_advert_interval_s: float = field(
+        default_factory=lambda: float(_env("CLUSTER_ADVERT_INTERVAL_S", "1.0"))
+    )
+    # graceful drain (lmstudio.admin.drain): in-flight decode gets this long
+    # to finish after the queue subs are dropped; the remainder is failed
+    # with the retryable draining envelope so peers absorb it
+    drain_deadline_s: float = field(
+        default_factory=lambda: float(_env("DRAIN_DEADLINE_S", "30"))
+    )
+    # router: an advert older than this marks the worker dead (dropped from
+    # steering). Must comfortably exceed the advert interval.
+    router_stale_after_s: float = field(
+        default_factory=lambda: float(_env("ROUTER_STALE_AFTER_S", "5.0"))
+    )
+    # router: prompt-head chars hashed for prefix-cache locality steering
+    # (0 disables locality; load-only steering remains)
+    router_prefix_head_chars: int = field(
+        default_factory=lambda: int(_env("ROUTER_PREFIX_HEAD_CHARS", "256"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count
@@ -218,6 +245,10 @@ class WorkerConfig:
             self.prefix_cache_blocks = 0
         if _env("SPEC_DECODE", "").strip().lower() in ("0", "false", "off"):
             self.spec_decode_k = 0
+        if not self.worker_id:
+            from .utils import next_nuid
+
+            self.worker_id = f"w-{next_nuid()[-8:].lower()}"
 
     def configure_jax(self) -> None:
         """Apply process-wide JAX settings. Must run before the first

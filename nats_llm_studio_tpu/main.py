@@ -7,6 +7,7 @@ fact"). This CLI is that wiring, made first-class:
     python -m nats_llm_studio_tpu serve            # worker against NATS_URL
     python -m nats_llm_studio_tpu serve --embedded-broker [--port 4222]
     python -m nats_llm_studio_tpu broker --port 4222 [--store-dir ./nats_data]
+    python -m nats_llm_studio_tpu route                # standalone cluster router
     python -m nats_llm_studio_tpu publish <model.gguf> <publisher>/<name>
     python -m nats_llm_studio_tpu chat <model_id> "prompt..."
 
@@ -101,6 +102,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
         obs_recorder=cfg.obs_recorder,
         obs_recorder_interval_ms=cfg.obs_recorder_interval_ms,
         obs_dump_dir=cfg.obs_dump_dir,
+        worker_id=cfg.worker_id,
     )
     worker = Worker(cfg, registry)
     await worker.start()
@@ -132,6 +134,35 @@ async def _run_broker(args: argparse.Namespace) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await broker.stop()
+
+
+async def _run_route(args: argparse.Namespace) -> None:
+    """Standalone cluster router (serve/router.py): subscribes to worker
+    adverts and forwards ``{prefix}.route.chat_model`` requests to the best
+    live worker. Clients that import this package should prefer the
+    in-process ClusterRouter; this process serves everyone else."""
+    from .serve.router import RouterProcess
+    from .transport import RetryPolicy, connect
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url, name="tpu-router")
+    proc = RouterProcess(
+        nc,
+        prefix=cfg.subject_prefix,
+        stale_after_s=cfg.router_stale_after_s,
+        prefix_head_chars=cfg.router_prefix_head_chars,
+        chat_timeout_s=cfg.chat_timeout_s,
+        retry=RetryPolicy(max_attempts=args.max_attempts, retry_on_timeout=True),
+    )
+    await proc.start()
+    log.info("router on %s (prefix %s)", cfg.nats_url, cfg.subject_prefix)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await proc.stop()
+    await nc.close()
 
 
 async def _run_publish(args: argparse.Namespace) -> None:
@@ -199,6 +230,9 @@ def main(argv: list[str] | None = None) -> None:
     bp.add_argument("--port", type=int, default=4222)
     bp.add_argument("--store-dir", default="./nats_data")
 
+    rp = sub.add_parser("route", help="run a standalone cluster router")
+    rp.add_argument("--max-attempts", type=int, default=3)
+
     pp = sub.add_parser("publish", help="import a GGUF and upload it to the bucket")
     pp.add_argument("gguf")
     pp.add_argument("model_id")
@@ -214,6 +248,7 @@ def main(argv: list[str] | None = None) -> None:
     runner = {
         "serve": _run_serve,
         "broker": _run_broker,
+        "route": _run_route,
         "publish": _run_publish,
         "chat": _run_chat,
     }[args.cmd]

@@ -29,9 +29,18 @@ def _num(v: float) -> str:
 
 
 class PromRenderer:
-    def __init__(self) -> None:
+    def __init__(self, default_labels: dict[str, str] | None = None) -> None:
         # family name -> (type, help, [sample lines])
         self._families: dict[str, tuple[str, str | None, list[str]]] = {}
+        # merged under every sample's labels (explicit labels win): the
+        # worker stamps worker_id here so a multi-worker scrape stays
+        # attributable without threading the label through every call site
+        self._default_labels = dict(default_labels or {})
+
+    def _merged(self, labels: dict | None) -> dict | None:
+        if not self._default_labels:
+            return labels
+        return {**self._default_labels, **(labels or {})}
 
     def _family(self, name: str, typ: str, help_: str | None) -> list[str]:
         fam = self._families.get(name)
@@ -44,16 +53,20 @@ class PromRenderer:
 
     def counter(self, name: str, value: float, labels: dict | None = None,
                 help: str | None = None) -> None:
-        self._family(name, "counter", help).append(f"{name}{_labels(labels)} {_num(value)}")
+        self._family(name, "counter", help).append(
+            f"{name}{_labels(self._merged(labels))} {_num(value)}"
+        )
 
     def gauge(self, name: str, value: float, labels: dict | None = None,
               help: str | None = None) -> None:
-        self._family(name, "gauge", help).append(f"{name}{_labels(labels)} {_num(value)}")
+        self._family(name, "gauge", help).append(
+            f"{name}{_labels(self._merged(labels))} {_num(value)}"
+        )
 
     def histogram(self, name: str, snap: HistSnapshot, labels: dict | None = None,
                   help: str | None = None) -> None:
         lines = self._family(name, "histogram", help)
-        base = dict(labels or {})
+        base = dict(self._merged(labels) or {})
         cum = 0
         for bound, c in zip(snap.bounds, snap.counts):
             cum += c

@@ -57,6 +57,7 @@ class FlightRecorder:
         dump_window_s: float = 30.0,
         dump_min_interval_s: float = _DEFAULT_MIN_INTERVAL_S,
         engine: str = "",
+        worker_id: str = "",
         counter_fns: dict | None = None,
         enabled: bool = True,
     ):
@@ -69,6 +70,9 @@ class FlightRecorder:
         self.dump_window_s = float(dump_window_s)
         self.dump_min_interval_s = float(dump_min_interval_s)
         self.engine = engine
+        # cluster identity: stamped on every frame and dump so artifacts
+        # from N workers sharing one OBS_DUMP_DIR stay attributable
+        self.worker_id = worker_id
         # name -> zero-arg callable returning a number; merged into every
         # frame so process-level counters (reconnects, engine restarts)
         # line up with batcher-level state on the same timeline
@@ -81,13 +85,17 @@ class FlightRecorder:
         self._lock = threading.Lock()
 
     @classmethod
-    def from_env(cls, *, engine: str = "", counter_fns: dict | None = None) -> "FlightRecorder":
+    def from_env(
+        cls, *, engine: str = "", worker_id: str = "",
+        counter_fns: dict | None = None,
+    ) -> "FlightRecorder":
         return cls(
             enabled=_env("OBS_RECORDER", "1") not in ("0", "false", "off"),
             interval_ms=float(_env("OBS_RECORDER_INTERVAL_MS", "250")),
             dump_dir=_env("OBS_DUMP_DIR", ""),
             dump_window_s=float(_env("OBS_DUMP_WINDOW_S", "30")),
             engine=engine,
+            worker_id=worker_id,
             counter_fns=counter_fns,
         )
 
@@ -109,6 +117,8 @@ class FlightRecorder:
         if now is None:
             now = time.monotonic()
         fr = {"ts": round(time.time(), 3), "mono": round(now, 3)}
+        if self.worker_id:
+            fr["worker_id"] = self.worker_id
         for name, fn in self.counter_fns.items():
             try:
                 fr[name] = fn()
@@ -177,6 +187,7 @@ class FlightRecorder:
         doc = {
             "reason": reason,
             "engine": self.engine,
+            "worker_id": self.worker_id,
             "ts": round(time.time(), 3),
             "mono": round(now, 3),
             "interval_ms": round(self.interval_s * 1e3, 3),
@@ -201,6 +212,7 @@ class FlightRecorder:
             reason=reason,
             path=path,
             engine=self.engine,
+            worker_id=self.worker_id,
             frames=len(doc["frames"]),
         )
         return path

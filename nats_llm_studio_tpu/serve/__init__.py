@@ -1,4 +1,15 @@
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
+from .router import ClusterRouter, RouterProcess, WorkerAdvert, prompt_head_hash
 from .worker import Worker
 
-__all__ = ["ChatEngine", "EngineError", "ModelNotFound", "Registry", "Worker"]
+__all__ = [
+    "ChatEngine",
+    "ClusterRouter",
+    "EngineError",
+    "ModelNotFound",
+    "Registry",
+    "RouterProcess",
+    "Worker",
+    "WorkerAdvert",
+    "prompt_head_hash",
+]

@@ -377,6 +377,7 @@ class LocalRegistry(Registry):
         obs_recorder: bool | None = None,
         obs_recorder_interval_ms: float | None = None,
         obs_dump_dir: str | None = None,
+        worker_id: str = "",
     ):
         self.store = store
         self.mesh = mesh
@@ -485,6 +486,9 @@ class LocalRegistry(Registry):
         # process-level counters merged into every recorder frame so
         # restart/reconnect counts sit on the same timeline as queue depth;
         # the worker registers its transport's reconnect counter here
+        # cluster identity (serve/router.py): stamped on recorder frames and
+        # anomaly dumps so N workers sharing one dump dir stay attributable
+        self.worker_id = worker_id
         self.recorder_counters: dict[str, Any] = {
             "engine_restarts": lambda: self.engine_restarts_total,
         }
@@ -871,6 +875,7 @@ class LocalRegistry(Registry):
             interval_ms=self.obs_recorder_interval_ms,
             dump_dir=self.obs_dump_dir,
             engine=model_id,
+            worker_id=self.worker_id,
             counter_fns=self.recorder_counters,
         )
         batcher = ContinuousBatcher(

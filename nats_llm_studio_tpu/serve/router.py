@@ -1,0 +1,445 @@
+"""Cluster membership + failover routing (ROADMAP item 3: queue-group
+scale-out made fault-tolerant).
+
+Every worker periodically publishes a compact advert on
+``{prefix}.cluster.adverts`` — worker id, queue depth, brownout level, HBM
+headroom, loaded models, draining flag, and the head hashes of recently
+served prompts. A :class:`ClusterRouter` subscribes, keeps a live member
+table, and steers chat requests at the *directed* per-worker subject
+(``{prefix}.worker.<id>.chat_model``) by advertised load and prefix-cache
+locality, falling back to the plain queue-group subject when no advert is
+live (a router with an empty table degrades to exactly the pre-cluster
+behavior — random queue-group delivery — never to an outage).
+
+Usable two ways:
+
+* **in-process**: attach to a ``NatsClient`` and call
+  :meth:`ClusterRouter.request_chat` instead of ``nc.request`` — the retry
+  loop re-picks a different worker per attempt and carries the
+  ``X-Excluded-Workers`` header so a shed/crashed worker is never retried
+  immediately.
+* **standalone**: :class:`RouterProcess` (``python -m nats_llm_studio_tpu
+  route``) forwards ``{prefix}.route.chat_model`` requests to the picked
+  worker and relays the reply — a thin L7 balancer for clients that want
+  steering without importing this package.
+
+Prefix-cache locality is approximated with a *text* head hash
+(:func:`prompt_head_hash`): the server-side radix cache keys on token-id
+chunks, but the router has no tokenizer — hashing the first N chars of the
+prompt is cheap, tokenizer-free, and identical on both sides. Equal text
+heads tokenize equally, so a head-hash hit implies real prefix-cache reuse
+on the sticky worker; a miss merely loses the locality bonus.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from hashlib import blake2b
+
+from ..obs import new_trace_id
+from ..transport import ConnectionClosedError, Msg, NatsClient, RetryPolicy
+from ..transport import protocol as p
+from ..transport.envelope import (
+    deadline_header_value,
+    deadline_remaining_s,
+    is_retryable_envelope,
+)
+
+log = logging.getLogger(__name__)
+
+ADVERT_SUBJECT = "cluster.adverts"  # published under the subject prefix
+ROUTE_SUBJECT = "route.chat_model"  # RouterProcess's forwarding subject
+DEFAULT_HEAD_CHARS = 256
+
+
+def prompt_head_hash(model: str, messages, chars: int = DEFAULT_HEAD_CHARS) -> str:
+    """Hash of the prompt head, for prefix-cache locality steering.
+
+    Computed identically by the worker (recording heads it served) and the
+    router (steering new requests): blake2b-64 over the model name and the
+    first ``chars`` characters of the concatenated message contents. Role
+    and content are length-delimited so ("ab","c") can't collide with
+    ("a","bc") across message boundaries.
+    """
+    h = blake2b(digest_size=8)
+    h.update(model.encode())
+    budget = max(0, chars)
+    for m in messages if isinstance(messages, list) else []:
+        if budget <= 0:
+            break
+        if not isinstance(m, dict):
+            continue
+        role = str(m.get("role", ""))
+        content = str(m.get("content", ""))[:budget]
+        budget -= len(content)
+        h.update(f"\x1f{len(role)}:{role}\x1f{len(content)}:".encode())
+        h.update(content.encode())
+    return h.hexdigest()
+
+
+class RecentHeads:
+    """Bounded LRU of recently served prompt-head hashes. The worker records
+    a head per admitted chat and adverts the set; the router treats a match
+    as prefix-cache locality. Plain dict insertion order is the LRU."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._heads: dict[str, None] = {}
+
+    def add(self, head: str) -> None:
+        self._heads.pop(head, None)
+        self._heads[head] = None
+        while len(self._heads) > self.capacity:
+            del self._heads[next(iter(self._heads))]
+
+    def snapshot(self) -> list[str]:
+        return list(self._heads)
+
+
+@dataclass
+class WorkerAdvert:
+    """One worker's most recent cluster advert, as the router sees it."""
+
+    worker_id: str
+    queue_depth: int = 0
+    brownout: int = 0  # 0 NORMAL / 1 BROWNOUT / 2 SHED_ONLY
+    hbm_headroom: float = 1.0
+    models: tuple[str, ...] = ()
+    draining: bool = False
+    heads: frozenset[str] = frozenset()
+    seq: int = 0
+    mono: float = 0.0  # ingest time (router clock; staleness = now - mono)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkerAdvert | None":
+        wid = d.get("worker_id")
+        if not isinstance(wid, str) or not wid:
+            return None
+        return cls(
+            worker_id=wid,
+            queue_depth=int(d.get("queue_depth") or 0),
+            brownout=int(d.get("brownout") or 0),
+            hbm_headroom=float(d.get("hbm_headroom", 1.0)),
+            models=tuple(m for m in d.get("models") or () if isinstance(m, str)),
+            draining=bool(d.get("draining")),
+            heads=frozenset(h for h in d.get("heads") or () if isinstance(h, str)),
+            seq=int(d.get("seq") or 0),
+            mono=time.monotonic(),
+        )
+
+
+@dataclass
+class RouterStats:
+    routed_total: int = 0  # requests steered at a directed subject
+    fallback_total: int = 0  # no live member: plain queue-group subject
+    locality_total: int = 0  # picks won by a prefix-head match
+    dead_marked_total: int = 0  # members dropped after a timeout/sever
+
+    def as_dict(self) -> dict:
+        return {
+            "routed_total": self.routed_total,
+            "fallback_total": self.fallback_total,
+            "locality_total": self.locality_total,
+            "dead_marked_total": self.dead_marked_total,
+        }
+
+
+class ClusterRouter:
+    """Live member table + steering. One per client (or per RouterProcess).
+
+    ``start()`` subscribes to the advert subject; until the first advert
+    lands every pick falls back to the queue-group subject, so attaching a
+    router is always safe — it only ever *adds* steering.
+    """
+
+    def __init__(
+        self,
+        nc: NatsClient,
+        *,
+        prefix: str = "lmstudio",
+        stale_after_s: float = 5.0,
+        prefix_head_chars: int = DEFAULT_HEAD_CHARS,
+        queue_group_fallback: bool = True,
+    ):
+        self.nc = nc
+        self.prefix = prefix
+        self.stale_after_s = stale_after_s
+        self.prefix_head_chars = prefix_head_chars
+        self.queue_group_fallback = queue_group_fallback
+        self.stats = RouterStats()
+        self._members: dict[str, WorkerAdvert] = {}
+        self._sub = None
+
+    # -- membership ----------------------------------------------------------
+
+    async def start(self) -> "ClusterRouter":
+        self._sub = await self.nc.subscribe(
+            f"{self.prefix}.{ADVERT_SUBJECT}", cb=self._on_advert
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+            self._sub = None
+
+    async def _on_advert(self, msg: Msg) -> None:
+        try:
+            d = msg.json()
+        except ValueError:
+            return
+        if isinstance(d, dict):
+            self.ingest(d)
+
+    def ingest(self, d: dict) -> None:
+        """Feed one advert dict (the sub callback does this; tests and the
+        bench can inject directly). Out-of-order adverts from one worker are
+        dropped by seq."""
+        adv = WorkerAdvert.from_dict(d)
+        if adv is None:
+            return
+        cur = self._members.get(adv.worker_id)
+        if cur is not None and adv.seq and adv.seq < cur.seq:
+            return
+        self._members[adv.worker_id] = adv
+
+    def mark_dead(self, worker_id: str) -> None:
+        """Drop a member NOW (observed timeout/sever) instead of waiting out
+        the staleness window — the next pick must not re-steer at it."""
+        if self._members.pop(worker_id, None) is not None:
+            self.stats.dead_marked_total += 1
+            log.info("router: marked worker %s dead", worker_id)
+
+    def members(self, *, live_only: bool = True) -> list[WorkerAdvert]:
+        if not live_only:
+            return list(self._members.values())
+        cutoff = time.monotonic() - self.stale_after_s
+        return [m for m in self._members.values() if m.mono >= cutoff]
+
+    # -- steering ------------------------------------------------------------
+
+    def worker_subject(self, worker_id: str, op: str = "chat_model") -> str:
+        """The directed (non-queue-group) subject one worker listens on."""
+        return f"{self.prefix}.worker.{worker_id}.{op}"
+
+    def pick(
+        self,
+        model: str | None = None,
+        messages=None,
+        excluded: tuple[str, ...] | list[str] = (),
+    ) -> str | None:
+        """Best live worker id, or None (caller falls back to the queue
+        group). Ranking: prefix-head locality first (a sticky worker replays
+        the cached prefill), then brownout level, then model-loaded, then
+        queue depth. Draining and excluded workers never win."""
+        head = None
+        if model and messages and self.prefix_head_chars > 0:
+            head = prompt_head_hash(model, messages, self.prefix_head_chars)
+        best: tuple | None = None
+        best_id: str | None = None
+        best_local = False
+        for m in self.members():
+            if m.draining or m.worker_id in excluded:
+                continue
+            local = head is not None and head in m.heads and m.brownout < 2
+            key = (
+                0 if local else 1,
+                m.brownout,
+                0 if (model and model in m.models) else 1,
+                m.queue_depth,
+                m.worker_id,  # total order: deterministic under ties
+            )
+            if best is None or key < best:
+                best, best_id, best_local = key, m.worker_id, local
+        if best_id is not None and best_local:
+            self.stats.locality_total += 1
+        return best_id
+
+    # -- steered request-reply ----------------------------------------------
+
+    async def request_chat(
+        self,
+        payload: dict | bytes,
+        timeout: float = 120.0,
+        headers: dict[str, str] | None = None,
+        retry: RetryPolicy | None = None,
+    ) -> Msg:
+        """Steered chat request: like ``nc.request(chat_subject, ...)`` with
+        a retry policy, but every attempt re-picks a worker from the live
+        member table, excluded workers accumulate across hops (header AND
+        pick filter), and a worker that times out is marked dead so
+        unrelated requests stop steering at it too."""
+        retry = retry or RetryPolicy()
+        if isinstance(payload, bytes):
+            body = payload
+            try:
+                obj = json.loads(payload or b"{}")
+            except ValueError:
+                obj = {}
+        else:
+            obj = payload
+            body = json.dumps(payload).encode()
+        model = obj.get("model") if isinstance(obj, dict) else None
+        messages = obj.get("messages") if isinstance(obj, dict) else None
+        headers = dict(headers) if headers else {}
+        headers.setdefault(p.TRACE_HEADER, new_trace_id())
+        headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
+        deadline_hdr = headers[p.DEADLINE_HEADER]
+        excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
+        fallback = f"{self.prefix}.chat_model"
+        last_exc: BaseException | None = None
+        last_msg: Msg | None = None
+        for attempt in range(1, retry.max_attempts + 1):
+            remaining = deadline_remaining_s(deadline_hdr)
+            attempt_timeout = timeout if remaining is None else min(timeout, remaining)
+            if attempt_timeout <= 0:
+                break
+            headers[p.ATTEMPT_HEADER] = str(attempt)
+            if excluded:
+                headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
+            wid = self.pick(model=model, messages=messages, excluded=excluded)
+            if wid is not None:
+                subject = self.worker_subject(wid)
+                self.stats.routed_total += 1
+            elif self.queue_group_fallback:
+                subject = fallback
+                self.stats.fallback_total += 1
+            else:
+                raise ConnectionClosedError("no live cluster members")
+            try:
+                msg = await self.nc.request(
+                    subject, body, timeout=attempt_timeout, headers=headers
+                )
+            except ConnectionClosedError as e:
+                last_exc, last_msg = e, None
+            except asyncio.TimeoutError as e:
+                if not retry.retry_on_timeout:
+                    raise
+                last_exc, last_msg = e, None
+                if wid is not None:
+                    # a directed request that never answered: the worker is
+                    # likely dead (adverts will confirm); steer away now
+                    self.mark_dead(wid)
+                    if wid not in excluded:
+                        excluded.append(wid)
+            else:
+                if attempt < retry.max_attempts and self._retryable(msg):
+                    last_exc, last_msg = None, msg
+                    shed_by = NatsClient._reply_worker_id(msg) or wid
+                    if shed_by and NatsClient._is_excluded_bounce(msg):
+                        # one-shot exclusion consumed (see client.request)
+                        if shed_by in excluded:
+                            excluded.remove(shed_by)
+                    elif shed_by and shed_by not in excluded:
+                        excluded.append(shed_by)
+                    if not excluded:
+                        headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
+                    if not await NatsClient._backoff_within_budget(
+                        retry.delay_s(attempt), deadline_hdr
+                    ):
+                        break
+                    continue
+                return msg
+            if attempt >= retry.max_attempts:
+                break
+            if not await NatsClient._backoff_within_budget(
+                retry.delay_s(attempt), deadline_hdr
+            ):
+                break
+        if last_msg is not None:
+            return last_msg
+        if last_exc is not None:
+            raise last_exc
+        raise asyncio.TimeoutError(
+            "deadline budget exhausted before steered chat request"
+        )
+
+    @staticmethod
+    def _retryable(msg: Msg) -> bool:
+        try:
+            env = json.loads(msg.payload or b"null")
+        except ValueError:
+            return False
+        return is_retryable_envelope(env)
+
+
+class RouterProcess:
+    """Thin standalone router: forwards ``{prefix}.route.chat_model``
+    requests to the steered worker and relays the reply verbatim. Runs in a
+    queue group so N router replicas split the forwarding load. Clients that
+    can import this package should prefer the in-process ClusterRouter (one
+    fewer hop); this process exists for everyone else."""
+
+    def __init__(
+        self,
+        nc: NatsClient,
+        *,
+        prefix: str = "lmstudio",
+        stale_after_s: float = 5.0,
+        prefix_head_chars: int = DEFAULT_HEAD_CHARS,
+        chat_timeout_s: float = 120.0,
+        retry: RetryPolicy | None = None,
+    ):
+        self.nc = nc
+        self.prefix = prefix
+        self.chat_timeout_s = chat_timeout_s
+        self.retry = retry or RetryPolicy(max_attempts=3, retry_on_timeout=True)
+        self.router = ClusterRouter(
+            nc,
+            prefix=prefix,
+            stale_after_s=stale_after_s,
+            prefix_head_chars=prefix_head_chars,
+        )
+        self._sub = None
+        self._inflight: set[asyncio.Task] = set()
+
+    async def start(self) -> "RouterProcess":
+        await self.router.start()
+        self._sub = await self.nc.subscribe(
+            f"{self.prefix}.{ROUTE_SUBJECT}",
+            queue="lmstudio-routers",
+            cb=self._on_chat,
+        )
+        log.info(
+            "router process forwarding %s.%s -> %s.worker.<id>.chat_model",
+            self.prefix, ROUTE_SUBJECT, self.prefix,
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+            self._sub = None
+        await self.router.stop()
+        for t in list(self._inflight):
+            t.cancel()
+
+    async def _on_chat(self, msg: Msg) -> None:
+        if not msg.reply:
+            return
+        task = asyncio.ensure_future(self._forward(msg))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _forward(self, msg: Msg) -> None:
+        headers = dict(msg.headers or {})
+        remaining = deadline_remaining_s(headers.get(p.DEADLINE_HEADER))
+        timeout = self.chat_timeout_s if remaining is None else remaining
+        if timeout <= 0:
+            return  # the caller already gave up; a reply would be unread
+        try:
+            resp = await self.router.request_chat(
+                msg.payload, timeout=timeout, headers=headers, retry=self.retry
+            )
+        except (ConnectionClosedError, asyncio.TimeoutError) as e:
+            from ..transport.envelope import envelope_error
+
+            await msg.respond(envelope_error(
+                f"router: no worker answered, retry on another worker ({e})"
+            ))
+            return
+        await msg.respond(resp.payload, headers=resp.headers)

@@ -42,8 +42,16 @@ from ..obs import (
 )
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
-from ..transport.protocol import ATTEMPT_HEADER, DEADLINE_HEADER, TRACE_HEADER
+from ..transport.protocol import (
+    ATTEMPT_HEADER,
+    DEADLINE_HEADER,
+    EXCLUDED_WORKERS_HEADER,
+    TRACE_HEADER,
+    WORKER_HEADER,
+    parse_worker_list,
+)
 from .api import EngineError, ModelNotFound, Registry
+from .router import ADVERT_SUBJECT, RecentHeads, prompt_head_hash
 
 log = logging.getLogger(__name__)
 
@@ -83,6 +91,7 @@ class Worker:
     def __init__(self, config: WorkerConfig, registry: Registry):
         self.config = config
         self.registry = registry
+        self.worker_id = config.worker_id
         self.nc: NatsClient | None = None
         self._started = asyncio.Event()
         self._stop = asyncio.Event()
@@ -91,6 +100,14 @@ class Worker:
         self._profiling = False
         self._supervisor_task: asyncio.Task | None = None
         self._t0 = time.monotonic()
+        # -- cluster state (serve/router.py) ---------------------------------
+        self.draining = False
+        self._queue_subs: list = []  # dropped on drain; control subs stay
+        self._advert_task: asyncio.Task | None = None
+        self._advert_seq = 0
+        self._recent_heads = RecentHeads()
+        self._excluded_bounce_total = 0  # X-Excluded-Workers self-matches
+        self._drain_bounce_total = 0  # requests bounced while draining
         # chat requests slower than this end-to-end land in the event ring
         # for post-hoc diagnosis (0 disables)
         self._slow_request_ms = float(
@@ -106,7 +123,9 @@ class Worker:
         install_compile_cache_listener()
         self.nc = await connect(
             cfg.nats_url,
-            name="tpu-worker",
+            # worker_id in the CONNECT name: the chaos harness's
+            # worker-scoped sever rule (faults.sever_worker) keys on it
+            name=f"tpu-worker-{self.worker_id}",
             max_reconnects=cfg.max_reconnects,
             reconnect_wait_s=cfg.reconnect_wait_s,
             reconnect_max_wait_s=cfg.reconnect_max_wait_s,
@@ -138,13 +157,36 @@ class Worker:
         if counters is not None:
             counters["reconnects"] = lambda: getattr(self.nc, "reconnects", 0)
             counters["requests_total"] = lambda: self._requests_total
+            counters["excluded_bounces"] = lambda: self._excluded_bounce_total
+            counters["drain_bounces"] = lambda: self._drain_bounce_total
         for subject, handler in subs.items():
-            await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
+            sub = await self.nc.subscribe(subject, queue=q, cb=self._guarded(handler))
+            self._queue_subs.append(sub)
+        # directed per-worker subjects (plain subs, NOT the queue group):
+        # the router steers at .chat_model; .health/.metrics.prom make one
+        # specific worker scrapeable (the queue-group subjects route to a
+        # random member). These survive a drain — control plane stays up.
+        wid_prefix = f"{cfg.subject_prefix}.worker.{self.worker_id}"
+        for op, handler in (
+            ("chat_model", self.on_chat_model),
+            ("health", self.on_health),
+            ("metrics.prom", self.on_metrics_prom),
+        ):
+            await self.nc.subscribe(f"{wid_prefix}.{op}", cb=self._guarded(handler))
+        # drain control: broadcast subject, each worker matches on payload
+        await self.nc.subscribe(
+            cfg.subject("admin.drain"), cb=self._guarded(self.on_admin_drain)
+        )
         await self.nc.flush()
         if cfg.supervise_interval_s > 0:
             self._supervisor_task = asyncio.ensure_future(self._supervise())
+        if getattr(cfg, "cluster_advert_interval_s", 0) > 0:
+            self._advert_task = asyncio.ensure_future(self._advert_loop())
         self._started.set()
-        log.info("worker serving %s.* (queue=%s)", cfg.subject_prefix, q)
+        log.info(
+            "worker %s serving %s.* (queue=%s)",
+            self.worker_id, cfg.subject_prefix, q,
+        )
 
     async def run(self) -> None:
         await self.start()
@@ -158,8 +200,146 @@ class Worker:
         if self._supervisor_task is not None:
             self._supervisor_task.cancel()
             self._supervisor_task = None
+        if self._advert_task is not None:
+            self._advert_task.cancel()
+            self._advert_task = None
         if self.nc is not None:
             await self.nc.drain()
+
+    # -- cluster adverts + graceful drain (ISSUE 10 tentpole) ----------------
+
+    def build_advert(self) -> dict:
+        """The compact membership advert ``{prefix}.cluster.adverts`` carries:
+        identity, load (queue depth summed over engines, worst brownout
+        level, HBM headroom), loaded models, draining flag, and the head
+        hashes of recently served prompts (router prefix-locality)."""
+        depth = 0
+        brownout = 0
+        for eng in self.registry.loaded_engines().values():
+            b = getattr(eng, "batcher", None)
+            if b is None:
+                continue
+            depth += int(getattr(b, "queue_depth", 0) or 0)
+            brownout = max(brownout, int(getattr(b, "brownout_level", 0) or 0))
+        headroom_fn = getattr(self.registry, "_hbm_headroom_frac", None)
+        try:
+            headroom = float(headroom_fn()) if headroom_fn is not None else 1.0
+        except Exception:  # noqa: BLE001 — an advert must never crash the loop
+            headroom = 1.0
+        return {
+            "worker_id": self.worker_id,
+            "queue_depth": depth,
+            "brownout": brownout,
+            "hbm_headroom": round(headroom, 4),
+            "models": sorted(self.registry.loaded_engines()),
+            "draining": self.draining,
+            "heads": self._recent_heads.snapshot(),
+            "seq": self._advert_seq,
+        }
+
+    async def _publish_advert(self) -> None:
+        if self.nc is None:
+            return
+        self._advert_seq += 1
+        try:
+            await self.nc.publish(
+                self.config.subject(ADVERT_SUBJECT),
+                json.dumps(self.build_advert(), separators=(",", ":")).encode(),
+            )
+        except (ConnectionError, ValueError):
+            pass  # reconnect in flight; the next tick re-advertises
+
+    async def _advert_loop(self) -> None:
+        try:
+            while True:
+                await self._publish_advert()
+                await asyncio.sleep(self.config.cluster_advert_interval_s)
+        except asyncio.CancelledError:
+            return
+
+    async def on_admin_drain(self, msg: Msg) -> None:
+        """admin.drain {worker_id, deadline_s?} — puts THE NAMED worker (or
+        every worker, with ``"*"``) into draining mode. Broadcast subject:
+        all workers hear it, only addressees act and reply."""
+        try:
+            req = json.loads(msg.payload or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in Drain: {e}")
+            return
+        target = (req.get("worker_id") or "").strip()
+        if not target:
+            await self._respond_error(
+                msg, "'worker_id' is required ('*' drains every worker)"
+            )
+            return
+        if target not in ("*", self.worker_id):
+            return  # addressed to a peer; its reply is the reply
+        try:
+            deadline_s = float(req.get("deadline_s", self.config.drain_deadline_s))
+        except (TypeError, ValueError):
+            await self._respond_error(msg, "'deadline_s' must be a number")
+            return
+        result = await self.begin_drain(deadline_s)
+        await self._respond_ok(msg, result)
+
+    async def begin_drain(self, deadline_s: float | None = None) -> dict:
+        """Graceful handoff: stop accepting new queue-group work (drop the
+        queue subs — the broker routes around us immediately), advertise the
+        draining flag, let in-flight decode finish up to the drain deadline,
+        then stop the batchers — which fail the remainder with the existing
+        retryable "worker draining, retry on another worker" envelope so the
+        client RetryPolicy lands them on a peer. Directed/control subjects
+        stay up: a draining worker still answers health and bounces chat."""
+        if deadline_s is None:
+            deadline_s = self.config.drain_deadline_s
+        if self.draining:
+            return {"worker_id": self.worker_id, "draining": True,
+                    "already_draining": True}
+        self.draining = True
+        EVENTS.emit("worker_drain", worker_id=self.worker_id,
+                    deadline_s=deadline_s)
+        log.info("worker %s draining (deadline %.1fs)", self.worker_id, deadline_s)
+        for sub in self._queue_subs:
+            await sub.unsubscribe()
+        self._queue_subs.clear()
+        await self._publish_advert()  # peers + routers see draining NOW
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        finished_in_time = True
+        while True:
+            busy = [
+                mid for mid, eng in self.registry.loaded_engines().items()
+                if getattr(getattr(eng, "batcher", None), "alive", False)
+                and not getattr(eng.batcher, "idle", True)
+            ]
+            if not busy:
+                break
+            if time.monotonic() >= deadline:
+                finished_in_time = False
+                log.warning(
+                    "worker %s drain deadline: %s still busy; failing the "
+                    "remainder retryably", self.worker_id, busy,
+                )
+                break
+            await asyncio.sleep(0.05)
+        stopped = []
+        for mid, eng in list(self.registry.loaded_engines().items()):
+            b = getattr(eng, "batcher", None)
+            if b is not None and getattr(b, "alive", False) and hasattr(b, "stop"):
+                # stop() drains in-flight slots with the retryable draining
+                # envelope (registry's shutdown finish path); it blocks on
+                # the owner thread, so keep the event loop breathing
+                await asyncio.to_thread(b.stop)
+                stopped.append(mid)
+        await self._publish_advert()
+        return {
+            "worker_id": self.worker_id,
+            "draining": True,
+            "finished_in_time": finished_in_time,
+            "stopped_engines": stopped,
+            "deadline_s": deadline_s,
+        }
 
     async def _supervise(self) -> None:
         """Engine watchdog: every ``supervise_interval_s`` check each loaded
@@ -176,6 +356,8 @@ class Worker:
         try:
             while True:
                 await asyncio.sleep(cfg.supervise_interval_s)
+                if self.draining:
+                    continue  # drain stops batchers on purpose; no restarts
                 for mid, eng in list(self.registry.loaded_engines().items()):
                     b = getattr(eng, "batcher", None)
                     if b is None or not hasattr(b, "alive"):
@@ -220,6 +402,11 @@ class Worker:
     # -- envelope helpers ----------------------------------------------------
 
     async def _respond_json(self, msg: Msg, payload: bytes, headers=None) -> None:
+        # every reply names its worker (X-Worker-Id): the client retry loop
+        # reads it to exclude a shedding worker from the next hop, and the
+        # router uses it to attribute replies in a multi-worker scrape
+        headers = dict(headers) if headers else {}
+        headers.setdefault(WORKER_HEADER, self.worker_id)
         try:
             await msg.respond(payload, headers=headers)
         except (ConnectionError, ValueError):
@@ -330,6 +517,32 @@ class Worker:
             attempt = None
         trace = Trace(hdrs.get(TRACE_HEADER) or new_trace_id(), attempt=attempt)
         trace.mark("recv")
+        if self.worker_id in parse_worker_list(hdrs.get(EXCLUDED_WORKERS_HEADER)):
+            # a queue-group redelivery landed the retry back on the worker
+            # that just shed/failed it: bounce retryably so the next hop
+            # (with us in the header) reaches a peer
+            self._excluded_bounce_total += 1
+            await self._respond_error(
+                msg,
+                "worker excluded by this request's retry history, "
+                "retry on another worker",
+                # excluded_bounce marks this as a one-shot deflection: the
+                # client drops us from the exclusion list after it, so a
+                # single-worker group (or one whose every member already
+                # shed once) can still serve the next attempt
+                {"worker_id": self.worker_id, "excluded_bounce": True},
+                trace_id=trace.trace_id,
+            )
+            return
+        if self.draining:
+            self._drain_bounce_total += 1
+            await self._respond_error(
+                msg,
+                "worker draining, retry on another worker",
+                {"worker_id": self.worker_id},
+                trace_id=trace.trace_id,
+            )
+            return
         if not msg.payload:
             await self._respond_error(msg, "empty payload in ChatModel", trace_id=trace.trace_id)
             return
@@ -351,6 +564,13 @@ class Worker:
         if payload.get("stream") and not msg.reply:
             return  # fire-and-forget stream request: nowhere to send tokens
         streaming = bool(payload.get("stream"))
+        if self.config.router_prefix_head_chars > 0:
+            # remember this prompt's head: the advert's ``heads`` set is the
+            # router's prefix-cache locality signal (same hash both sides)
+            self._recent_heads.add(prompt_head_hash(
+                model_id, payload.get("messages"),
+                self.config.router_prefix_head_chars,
+            ))
         payload["_trace"] = trace  # engines pop it; fakes ignore it
         if self.config.deadline_propagation:
             # client budget (X-Deadline-Ms, wall ms) → monotonic deadline
@@ -471,7 +691,8 @@ class Worker:
         await self.nc.publish(
             msg.reply,
             envelope_ok({"http_status": 200, "response": final}, trace_id=trace.trace_id),
-            headers={"Nats-Stream-Done": "1", "X-Seq": str(seq)},
+            headers={"Nats-Stream-Done": "1", "X-Seq": str(seq),
+                     WORKER_HEADER: self.worker_id},
         )
 
     async def on_sync_model_from_bucket(self, msg: Msg) -> None:
@@ -505,7 +726,9 @@ class Worker:
         """health — heartbeat + counters (SURVEY.md §5: the reference has no
         health subject; client timeout is its only failure detector)."""
         data = {
-            "status": "ok",
+            "status": "draining" if self.draining else "ok",
+            "worker_id": self.worker_id,
+            "draining": self.draining,
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "requests_total": self._requests_total,
             "tokens_total": self._tokens_total,
@@ -556,8 +779,17 @@ class Worker:
     def render_prometheus(self) -> str:
         """Worker totals + registry gauges + per-engine batcher counters and
         histograms in Prometheus text exposition (obs/prom.py)."""
-        r = PromRenderer()
+        # worker_id on every family: a multi-worker scrape (or one pushed
+        # through a shared gateway) stays attributable per worker
+        r = PromRenderer(default_labels={"worker_id": self.worker_id})
         r.gauge("lmstudio_uptime_seconds", round(time.monotonic() - self._t0, 3))
+        r.gauge("lmstudio_draining", 1 if self.draining else 0,
+                help="1 while this worker is in graceful drain")
+        r.counter("lmstudio_excluded_bounce_total", self._excluded_bounce_total,
+                  help="chat requests bounced retryably because this worker "
+                       "appeared in their X-Excluded-Workers header")
+        r.counter("lmstudio_drain_bounce_total", self._drain_bounce_total,
+                  help="chat requests bounced retryably while draining")
         r.counter("lmstudio_requests_total", self._requests_total,
                   help="NATS requests handled by this worker")
         r.counter("lmstudio_tokens_total", self._tokens_total,
@@ -784,7 +1016,7 @@ class Worker:
         if want is not None and not engines:
             await self._respond_error(msg, f"model not loaded: {want}")
             return
-        await self._respond_ok(msg, {"engines": engines})
+        await self._respond_ok(msg, {"worker_id": self.worker_id, "engines": engines})
 
     async def on_debug_dump(self, msg: Msg) -> None:
         """debug.dump — force a flight-recorder dump for every loaded engine

@@ -51,6 +51,7 @@ class _ClientConn:
         self.parser = p.Parser()
         self.subs: dict[str, _Sub] = {}
         self.cid = broker._next_cid()
+        self.name = ""  # CONNECT name; chaos rules scope severs by it
         self.closed = False
         self._out = asyncio.Queue[bytes | None]()
         self._pending = 0  # bytes enqueued but not yet written to the socket
@@ -155,13 +156,15 @@ class _ClientConn:
                 self.send(p.encode_err("Maximum Payload Violation"))
                 return
             if _faults.ACTIVE is not None:  # chaos harness; off ⇒ one attr read
-                f = _faults.ACTIVE.check(_faults.BROKER_PUBLISH, ev.subject)
+                f = _faults.ACTIVE.check(_faults.BROKER_PUBLISH, ev.subject,
+                                         client=self.name)
                 if f is not None:
                     if f.kind == "sever":
                         # drop the publisher's TCP connection; the message is
-                        # lost, exactly like a broker crash mid-publish
-                        log.warning("chaos: severing client %d on publish to %s",
-                                    self.cid, ev.subject)
+                        # lost, exactly like a broker crash mid-publish (or,
+                        # with a client= scoped rule, that worker dying)
+                        log.warning("chaos: severing client %d (%s) on publish to %s",
+                                    self.cid, self.name or "unnamed", ev.subject)
                         await self._close()
                         return
                     if f.kind == "drop":
@@ -193,7 +196,11 @@ class _ClientConn:
             if ev.op == "PING":
                 self.send(p.PONG)
         elif isinstance(ev, p.ConnectEvent):
-            pass  # no auth in embedded mode
+            # no auth in embedded mode; keep the advertised name so
+            # client-scoped chaos rules can target one worker's connection
+            name = ev.options.get("name")
+            if isinstance(name, str):
+                self.name = name
 
 
 InternalHandler = Callable[[str, bytes, str | None, dict[str, str] | None], Awaitable[None]]

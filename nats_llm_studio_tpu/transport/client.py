@@ -45,7 +45,7 @@ from ..obs import emit as obs_emit
 from ..utils import next_nuid
 from . import faults as _faults
 from . import protocol as p
-from .envelope import deadline_header_value, is_retryable_envelope
+from .envelope import deadline_header_value, deadline_remaining_s, is_retryable_envelope
 
 log = logging.getLogger(__name__)
 
@@ -595,7 +595,20 @@ class NatsClient:
         ``retry.max_attempts`` times with backoff; each re-issue uses a
         fresh inbox token, so a late reply to an abandoned attempt can never
         be mistaken for the current one. The final attempt's envelope (even
-        a retryable error) is returned honestly."""
+        a retryable error) is returned honestly.
+
+        ONE absolute deadline (``X-Deadline-Ms``, minted from the first
+        attempt's timeout unless the caller stamped it) spans every attempt:
+        each attempt's timeout is capped by the remaining budget, backoff
+        sleeps never outlast it, and when the budget is gone the last
+        retryable envelope (or error) surfaces immediately instead of
+        sleeping past the caller's deadline.
+
+        Workers echo their id in the ``X-Worker-Id`` reply header (and the
+        envelope's ``data.worker_id``); each retryable failure adds it to
+        the ``X-Excluded-Workers`` header of the next attempt, so a worker
+        that just shed (or died under) this request bounces a queue-group
+        redelivery retryably instead of serving the retry."""
         if retry is None:
             return await self._request_once(subject, payload, timeout, headers)
         # ONE trace id spans every attempt of a retried request (minted
@@ -604,20 +617,53 @@ class NatsClient:
         # cluster's traces. The attempt header tells the spans apart.
         headers = dict(headers) if headers else {}
         headers.setdefault(p.TRACE_HEADER, new_trace_id())
+        headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
+        deadline_hdr = headers[p.DEADLINE_HEADER]
+        excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
         last_exc: BaseException | None = None
+        last_msg: Msg | None = None
         for attempt in range(1, retry.max_attempts + 1):
+            remaining = deadline_remaining_s(deadline_hdr)
+            attempt_timeout = (
+                timeout if remaining is None else min(timeout, remaining)
+            )
+            if attempt_timeout <= 0:
+                break  # budget exhausted: report the last outcome honestly
             headers[p.ATTEMPT_HEADER] = str(attempt)
             try:
-                msg = await self._request_once(subject, payload, timeout, headers)
+                msg = await self._request_once(
+                    subject, payload, attempt_timeout, headers
+                )
             except ConnectionClosedError as e:
-                last_exc = e
+                last_exc, last_msg = e, None
             except asyncio.TimeoutError as e:
                 if not retry.retry_on_timeout:
                     raise
-                last_exc = e
+                last_exc, last_msg = e, None
             else:
                 if attempt < retry.max_attempts and self._retryable_reply(msg):
-                    await asyncio.sleep(retry.delay_s(attempt))
+                    last_exc, last_msg = None, msg
+                    wid = self._reply_worker_id(msg)
+                    if wid:
+                        if self._is_excluded_bounce(msg):
+                            # exclusion is one-shot: the bounce already
+                            # deflected the immediate retry, so drop the
+                            # worker — a single-worker group (or one whose
+                            # every member shed once) must stay servable
+                            if wid in excluded:
+                                excluded.remove(wid)
+                        elif wid not in excluded:
+                            excluded.append(wid)
+                        if excluded:
+                            headers[p.EXCLUDED_WORKERS_HEADER] = (
+                                p.format_worker_list(excluded)
+                            )
+                        else:
+                            headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
+                    if not await self._backoff_within_budget(
+                        retry.delay_s(attempt), deadline_hdr
+                    ):
+                        break
                     continue
                 return msg
             if attempt >= retry.max_attempts:
@@ -625,12 +671,47 @@ class NatsClient:
             if isinstance(last_exc, ConnectionClosedError) and not self._closed.is_set():
                 # give the reconnect a chance before burning the next attempt
                 try:
-                    await asyncio.wait_for(self._connected.wait(), timeout)
+                    await asyncio.wait_for(self._connected.wait(), attempt_timeout)
                 except asyncio.TimeoutError:
                     pass
-            await asyncio.sleep(retry.delay_s(attempt))
-        assert last_exc is not None
-        raise last_exc
+            if not await self._backoff_within_budget(
+                retry.delay_s(attempt), deadline_hdr
+            ):
+                break
+        if last_msg is not None:
+            return last_msg
+        if last_exc is not None:
+            raise last_exc
+        raise asyncio.TimeoutError(
+            f"deadline budget exhausted before request to {subject}"
+        )
+
+    @staticmethod
+    async def _backoff_within_budget(delay: float, deadline_hdr: str) -> bool:
+        """Sleep ``delay`` only if the deadline budget survives it; False
+        means the budget is (or would be) exhausted and retrying must stop
+        now rather than sleeping past the caller's deadline."""
+        remaining = deadline_remaining_s(deadline_hdr)
+        if remaining is not None and delay >= remaining:
+            return False
+        await asyncio.sleep(delay)
+        return True
+
+    @staticmethod
+    def _reply_worker_id(msg: Msg) -> str | None:
+        """The replying worker's id: the ``X-Worker-Id`` header when
+        present, else the envelope's ``data.worker_id``."""
+        wid = (msg.headers or {}).get(p.WORKER_HEADER)
+        if wid:
+            return wid
+        try:
+            env = json.loads(msg.payload or b"null")
+        except ValueError:
+            return None
+        if isinstance(env, dict) and isinstance(env.get("data"), dict):
+            wid = env["data"].get("worker_id")
+            return wid if isinstance(wid, str) and wid else None
+        return None
 
     @staticmethod
     def _retryable_reply(msg: Msg) -> bool:
@@ -639,6 +720,18 @@ class NatsClient:
         except ValueError:
             return False
         return is_retryable_envelope(env)
+
+    @staticmethod
+    def _is_excluded_bounce(msg: Msg) -> bool:
+        """True for a worker's self-check bounce (it matched the request's
+        ``X-Excluded-Workers`` header) — the one retryable reply that should
+        SHRINK the exclusion list instead of growing it."""
+        try:
+            env = json.loads(msg.payload or b"null")
+        except ValueError:
+            return False
+        return isinstance(env, dict) and isinstance(env.get("data"), dict) \
+            and bool(env["data"].get("excluded_bounce"))
 
     async def _request_once(
         self,

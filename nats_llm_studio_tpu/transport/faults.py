@@ -22,7 +22,10 @@ Rule grammar: ``kind@site:step[:key=value]...`` where ``kind`` is one of
 ``sever`` | ``drop`` | ``delay`` | ``raise``, ``site`` is a hook-site name
 below, ``step`` is the 0-based matching-call index at which the rule fires,
 and optional keys are ``subject=<pattern>`` (NATS wildcard filter — only
-matching publishes count), ``delay=<seconds>`` and ``msg=<text>``.
+matching publishes count), ``client=<glob>`` (connection-name filter: only
+events from a client whose CONNECT name matches count — the worker-scoped
+kill switch, since every worker connects as ``tpu-worker-<worker_id>``),
+``delay=<seconds>`` and ``msg=<text>``.
 """
 
 from __future__ import annotations
@@ -32,6 +35,7 @@ import os
 import random
 import threading
 from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
 
 from ..utils import subject_matches
 
@@ -56,6 +60,7 @@ class Fault:
     step: int  # fires on the (step+1)-th MATCHING check() call (0-based index)
     kind: str  # "sever" | "drop" | "delay" | "raise"
     subject: str | None = None  # NATS wildcard filter; None matches everything
+    client: str | None = None  # connection-name glob; None matches everything
     delay_s: float = 0.0
     message: str = "injected fault (chaos)"
     fired: bool = False
@@ -68,6 +73,8 @@ class Fault:
         s = f"{self.kind}@{self.site}:{self.step}"
         if self.subject:
             s += f":subject={self.subject}"
+        if self.client:
+            s += f":client={self.client}"
         if self.kind == "delay":
             s += f":delay={self.delay_s}"
         return s
@@ -94,8 +101,20 @@ class FaultPlan:
         self.faults.append(fault)
         return self
 
-    def sever(self, site: str, step: int, subject: str | None = None) -> "FaultPlan":
-        return self.add(Fault(site=site, step=step, kind="sever", subject=subject))
+    def sever(self, site: str, step: int, subject: str | None = None,
+              client: str | None = None) -> "FaultPlan":
+        return self.add(
+            Fault(site=site, step=step, kind="sever", subject=subject, client=client)
+        )
+
+    def sever_worker(self, worker_id: str, step: int,
+                     subject: str | None = None) -> "FaultPlan":
+        """Worker-scoped kill switch: sever the connection of the worker
+        whose id is ``worker_id`` on its (step+1)-th matching publish — the
+        wire-level equivalent of kill -9 on that worker, mid-flight. Matches
+        the ``tpu-worker-<worker_id>`` CONNECT name serve/worker.py uses."""
+        return self.sever(BROKER_PUBLISH, step, subject=subject,
+                          client=f"tpu-worker-{worker_id}")
 
     def drop(self, site: str, step: int, subject: str | None = None) -> "FaultPlan":
         return self.add(Fault(site=site, step=step, kind="drop", subject=subject))
@@ -114,10 +133,14 @@ class FaultPlan:
 
     # -- hook API ------------------------------------------------------------
 
-    def check(self, site: str, subject: str | None = None) -> Fault | None:
+    def check(
+        self, site: str, subject: str | None = None, client: str | None = None
+    ) -> Fault | None:
         """Count one event at ``site`` against every matching rule; return
         the first rule that fires on this event (None otherwise). A rule
-        fires exactly once, when its matching-call count passes ``step``."""
+        fires exactly once, when its matching-call count passes ``step``.
+        ``client`` is the originating connection's CONNECT name, for
+        client-scoped (worker-scoped) rules."""
         if not self.faults:
             return None
         with self._lock:
@@ -129,13 +152,19 @@ class FaultPlan:
                     subject is not None and subject_matches(f.subject, subject)
                 ):
                     continue
+                if f.client is not None and not fnmatchcase(client or "", f.client):
+                    continue
                 f.hits += 1
                 if not f.fired and f.hits > f.step:
                     f.fired = True
-                    self.log.append(
-                        {"site": site, "kind": f.kind, "step": f.step,
-                         "subject": subject}
-                    )
+                    entry = {"site": site, "kind": f.kind, "step": f.step,
+                             "subject": subject}
+                    if f.client is not None:
+                        # only client-scoped rules record the connection
+                        # name: the log-entry shape of existing rules is a
+                        # test contract
+                        entry["client"] = client
+                    self.log.append(entry)
                     if hit is None:
                         hit = f
             return hit
@@ -198,6 +227,8 @@ def plan_from_env(environ=None) -> FaultPlan | None:
                 key, _, val = extra.partition("=")
                 if key == "subject":
                     f.subject = val
+                elif key == "client":
+                    f.client = val
                 elif key == "delay":
                     f.delay_s = float(val)
                 elif key == "msg":

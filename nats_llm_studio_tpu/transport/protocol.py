@@ -207,6 +207,31 @@ ATTEMPT_HEADER = "X-Attempt"
 # abort work whose caller has already given up
 DEADLINE_HEADER = "X-Deadline-Ms"
 
+# the serving worker's stable cluster id, echoed on every reply (and in
+# cluster adverts): the client retry loop reads it to learn WHO shed the
+# request, so the next hop can steer around that worker
+WORKER_HEADER = "X-Worker-Id"
+
+# comma-separated worker ids that already failed/shed this logical request:
+# stamped by the retrying client before each re-issue, read by workers
+# (which bounce retryably when they see their own id — a queue-group
+# redelivery must not land a retry back on the worker that just shed it)
+# and by the router (which never steers at an excluded worker)
+EXCLUDED_WORKERS_HEADER = "X-Excluded-Workers"
+
+
+def parse_worker_list(value: str | None) -> list[str]:
+    """Decode an ``X-Excluded-Workers`` header into worker ids (order kept,
+    empties dropped); tolerant of None/garbage — a bad header must never
+    fail a request that would otherwise serve."""
+    if not value:
+        return []
+    return [w for w in (tok.strip() for tok in value.split(",")) if w]
+
+
+def format_worker_list(ids: list[str]) -> str:
+    return ",".join(ids)
+
 
 def parse_headers(raw: bytes) -> dict[str, str]:
     headers: dict[str, str] = {}

@@ -399,10 +399,14 @@ async def test_prometheus_deadline_and_brownout_families(model):
                 return {"acme/dl": _Eng()}
 
         w = Worker(WorkerConfig(), _Reg())
+        wid = w.worker_id
         text = w.render_prometheus()
-        assert '\nlmstudio_deadline_shed_total{model="acme/dl"} 0\n' in text
-        assert '\nlmstudio_deadline_aborted_total{model="acme/dl"} 0\n' in text
-        assert '\nlmstudio_brownout_level{model="acme/dl"} 0\n' in text
+        assert (f'\nlmstudio_deadline_shed_total'
+                f'{{model="acme/dl",worker_id="{wid}"}} 0\n') in text
+        assert (f'\nlmstudio_deadline_aborted_total'
+                f'{{model="acme/dl",worker_id="{wid}"}} 0\n') in text
+        assert (f'\nlmstudio_brownout_level'
+                f'{{model="acme/dl",worker_id="{wid}"}} 0\n') in text
 
         # fire one submit-side shed and check the counter + cause label move
         with pytest.raises(BatcherOverloaded):
@@ -411,8 +415,9 @@ async def test_prometheus_deadline_and_brownout_families(model):
                     deadline=time.monotonic() - 1.0):
                 pass
         text = w.render_prometheus()
-        assert '\nlmstudio_deadline_shed_total{model="acme/dl"} 1\n' in text
-        assert ('\nlmstudio_batcher_shed_by_cause_total'
-                '{cause="deadline",model="acme/dl"} 1\n') in text
+        assert (f'\nlmstudio_deadline_shed_total'
+                f'{{model="acme/dl",worker_id="{wid}"}} 1\n') in text
+        assert (f'\nlmstudio_batcher_shed_by_cause_total'
+                f'{{cause="deadline",model="acme/dl",worker_id="{wid}"}} 1\n') in text
     finally:
         b.stop()

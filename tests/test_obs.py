@@ -417,8 +417,11 @@ async def test_trace_and_metrics_e2e_over_embedded_broker(tmp_path):
         assert "# TYPE lmstudio_admit_queue_delay_ms histogram" in text
         assert "# TYPE lmstudio_ttft_ms histogram" in text
         assert "# TYPE lmstudio_decode_step_ms histogram" in text
-        assert 'lmstudio_ttft_ms_bucket{le="+Inf",model="acme/obs"}' in text
-        assert 'lmstudio_admit_queue_delay_ms_count{model="acme/obs"} 2' in text
+        # every family carries the worker_id default label (cluster scrapes
+        # stay attributable), so match the label prefix, not the full set
+        wid = worker.worker_id
+        assert f'lmstudio_ttft_ms_bucket{{le="+Inf",model="acme/obs",worker_id="{wid}"}}' in text
+        assert f'lmstudio_admit_queue_delay_ms_count{{model="acme/obs",worker_id="{wid}"}} 2' in text
         assert "# TYPE lmstudio_requests_total counter" in text
         assert "lmstudio_batcher_requests_total" in text
         # per-program device timing: one labeled histogram family over every
