@@ -1819,17 +1819,20 @@ def obs_overhead_bench(cfg, params, *, seq: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-def _export_tiny_gguf(models_dir, mid: str, seed: int = 5) -> None:
+def _export_tiny_gguf(models_dir, mid: str, seed: int = 5,
+                      max_seq_len: int = 64) -> None:
     """Export a 2-layer tiny model with a byte-level gpt2 tokenizer to
     ``models_dir/mid/m.gguf`` — the resilience phases (chaos, cluster) run
-    it so they measure the recovery machinery, not XLA."""
+    it so they measure the recovery machinery, not XLA. ``max_seq_len``
+    sizes the context (the gateway phase needs prompts past a full prefill
+    chunk so the n-fan-out actually shares prefix blocks)."""
     from pathlib import Path
 
     from nats_llm_studio_tpu.gguf.constants import TokenType
     from nats_llm_studio_tpu.gguf.tokenizer import _byte_to_unicode
     from nats_llm_studio_tpu.models.export import export_params_to_gguf
 
-    tcfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    tcfg = ModelConfig.tiny(n_layers=2, max_seq_len=max_seq_len)
     tparams = init_params(tcfg, jax.random.PRNGKey(seed))
     b2u = _byte_to_unicode()
     tokens = [b2u[b] for b in range(256)]
@@ -2188,6 +2191,210 @@ def cluster_bench(*, n_workers: int | None = None, n_clients: int | None = None,
         return asyncio.run(run(Path(td) / "models"))
 
 
+def gateway_bench(*, n_reqs: int | None = None,
+                  max_new: int | None = None) -> dict:
+    """OpenAI HTTP front-door phase (gateway/server.py), three questions:
+
+    (a) what does the HTTP/SSE hop cost? — streaming TTFT p50 through the
+        gateway vs the SAME request raw over NATS, same worker, same model;
+    (b) what does the fused constrained-decode mask cost per step? — an
+        all-True mask forces the masked ext program while changing nothing
+        about the distribution, so greedy tokens must stay bit-identical
+        and the wall-clock delta IS the mask machinery;
+    (c) what do n=4 prompt-sharing choices cost in HBM? — peak live paged-KV
+        blocks for n=4 vs n=1 (siblings admit as zero-copy shares of the
+        choice-0 prompt blocks, so the ratio lands well under 4x).
+
+    Runs the tiny model so it measures the gateway and batcher machinery,
+    not XLA."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.engine.generator import SamplingParams
+    from nats_llm_studio_tpu.gateway import Gateway
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.store.manager import ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, connect
+
+    mid = "bench/gw-tiny"
+    n_reqs = n_reqs or int(os.environ.get("BENCH_GATEWAY_REQS", "6"))
+    max_new = max_new or int(os.environ.get("BENCH_GATEWAY_NEW", "16"))
+
+    class _AllowAll:
+        """All-True token mask: routes decode through the masked ext
+        program without constraining anything."""
+
+        def __init__(self, vocab):
+            self.vocab = vocab
+            self.start = 0
+
+        def mask(self, state):
+            return np.ones(self.vocab, dtype=bool)
+
+        def advance(self, state, tid):
+            return state
+
+        def live(self, state):
+            return True
+
+        def accepting(self, state):
+            return True
+
+    async def run(models_dir: Path) -> dict:
+        # 512-token context: prefill chunks stay at 256, so the fan-out
+        # prompt below can span a FULL chunk — prefix-cache harvest (and
+        # therefore sibling block sharing) only engages on whole chunks
+        _export_tiny_gguf(models_dir, mid, max_seq_len=512)
+        broker = await EmbeddedBroker().start()
+        registry = LocalRegistry(
+            ModelStore(models_dir), dtype="float32",
+            max_batch_slots=8, max_seq_len=512,
+        )
+        worker = Worker(WorkerConfig(nats_url=broker.url), registry)
+        await worker.start()
+        nc = await connect(broker.url)
+        gw = await Gateway(nc, port=0).start()
+
+        stream_req = {
+            "model": mid,
+            "messages": [{"role": "user", "content": "ttft probe"}],
+            "max_tokens": 4, "temperature": 0.0, "stream": True,
+        }
+        raw_body = json.dumps(stream_req).encode()
+
+        async def raw_ttft() -> float:
+            agen = nc.request_stream("lmstudio.chat_model", raw_body,
+                                     timeout=60.0)
+            t0 = time.perf_counter()
+            try:
+                async for _ in agen:
+                    return time.perf_counter() - t0
+            finally:
+                await agen.aclose()
+            raise RuntimeError("raw stream yielded nothing")
+
+        http_head = (
+            f"POST /v1/chat/completions HTTP/1.1\r\nHost: b\r\n"
+            f"Content-Length: {len(raw_body)}\r\n\r\n"
+        ).encode() + raw_body
+
+        async def gw_ttft() -> float:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           gw.port)
+            try:
+                t0 = time.perf_counter()
+                writer.write(http_head)
+                await writer.drain()
+                await reader.readuntil(b"\r\n\r\n")   # response head
+                await reader.readuntil(b"\n\n")       # first SSE event
+                return time.perf_counter() - t0
+            finally:
+                writer.close()
+
+        # warm both paths (engine load + compiles land here, not in p50)
+        await raw_ttft()
+        await gw_ttft()
+        raw_s = sorted([await raw_ttft() for _ in range(n_reqs)])
+        via_s = sorted([await gw_ttft() for _ in range(n_reqs)])
+        raw_p50 = _pctl(raw_s, 0.50) * 1e3
+        via_p50 = _pctl(via_s, 0.50) * 1e3
+        ttft = {
+            "raw_nats_p50_ms": round(raw_p50, 2),
+            "gateway_p50_ms": round(via_p50, 2),
+            "http_hop_delta_ms": round(via_p50 - raw_p50, 2),
+            "reqs": n_reqs,
+        }
+
+        # (b) constrained-mask per-step overhead on the live batcher
+        eng = await registry.get_engine(mid)
+        batcher = eng.batcher
+        sp = SamplingParams(temperature=0.0, max_tokens=max_new)
+        ids = [3, 1, 4, 1, 5]
+        dfa = _AllowAll(eng.cfg.vocab_size)
+
+        async def timed(constrain) -> tuple[float, list]:
+            t0 = time.perf_counter()
+            toks = [t async for t in batcher.submit(ids, sp,
+                                                    constrain=constrain)]
+            return time.perf_counter() - t0, toks
+
+        await timed(None)       # warm the plain program
+        await timed(dfa)        # warm the masked ext program
+        plain_s, plain_toks = min([await timed(None) for _ in range(3)],
+                                  key=lambda r: r[0])
+        ext_s, ext_toks = min([await timed(dfa) for _ in range(3)],
+                              key=lambda r: r[0])
+        per_plain = plain_s / max(1, len(plain_toks)) * 1e3
+        per_ext = ext_s / max(1, len(ext_toks)) * 1e3
+        constrained = {
+            "plain_ms_per_tok": round(per_plain, 3),
+            "masked_ms_per_tok": round(per_ext, 3),
+            "overhead_pct": round((per_ext / per_plain - 1.0) * 100, 1)
+            if per_plain else 0.0,
+            # the bit-identity claim, measured: an all-True mask through the
+            # ext program must not change a single greedy token
+            "identical_tokens": ext_toks == plain_toks,
+        }
+
+        # (c) n=4 vs n=1 peak paged-KV block cost through the n fan-out.
+        # The prompt spans a full 256-token prefill chunk so choice 0's
+        # prompt blocks land in the prefix cache and the three siblings
+        # admit as zero-copy shares of them. Counts are relative to the
+        # pre-request pool state (prefix-cache residents stay live).
+        async def peak_blocks(n: int, content: str) -> tuple[int, int]:
+            payload = {
+                "model": mid,
+                "messages": [{"role": "user", "content": content}],
+                "max_tokens": 10, "temperature": 0.8, "seed": 3, "n": n,
+            }
+            st0 = batcher.pool_stats()
+            task = asyncio.ensure_future(eng.chat(payload))
+            peak_live = peak_shared = 0
+            while not task.done():
+                st = batcher.pool_stats()
+                if st is not None:
+                    peak_live = max(peak_live,
+                                    st["blocks_live"] - st0["blocks_live"])
+                    peak_shared = max(peak_shared, st["blocks_shared"])
+                await asyncio.sleep(0.002)
+            await task
+            return peak_live, peak_shared
+
+        fanout: dict = {}
+        if batcher.pool_stats() is not None:
+            # distinct prompts per arm: no cross-arm prefix-cache hits
+            n1_live, _ = await peak_blocks(1, "a" * 300)
+            n4_live, n4_shared = await peak_blocks(4, "b" * 300)
+            fanout = {
+                "n1_peak_blocks_live": n1_live,
+                "n4_peak_blocks_live": n4_live,
+                "n4_peak_blocks_shared": n4_shared,
+                "blocks_ratio": round(n4_live / n1_live, 2) if n1_live else 0.0,
+                "cow_copies": batcher.pool_stats()["cow_copies"],
+            }
+        else:
+            fanout = {"skipped": "paged KV off (KV_PAGED=0)"}
+
+        out = {
+            "ttft": ttft,
+            "constrained_mask": constrained,
+            "n_fanout": fanout,
+            "gateway_requests_total": gw.requests_total,
+            "gateway_streams_total": gw.streams_total,
+        }
+        await gw.stop()
+        await nc.close()
+        await worker.drain()
+        await broker.stop()
+        return out
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(run(Path(td) / "models"))
+
+
 FINAL_LINE_BUDGET = 2000  # harness line-buffer bound on the final JSON line
 
 
@@ -2372,6 +2579,13 @@ def main() -> None:
             _run_phase(tiny_detail, "cluster", lambda: cluster_bench(
                 n_workers=2, n_clients=12, reqs_per_client=2, max_new=8,
             ))
+        if os.environ.get("BENCH_GATEWAY", "1") != "0":
+            # micro-run of the HTTP front-door phase: gateway-vs-raw TTFT,
+            # all-True-mask per-step overhead (tokens must stay identical),
+            # and the n=4 prompt-sharing block cost (CI smoke)
+            _run_phase(tiny_detail, "gateway", lambda: gateway_bench(
+                n_reqs=4, max_new=12,
+            ))
         _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
@@ -2495,6 +2709,11 @@ def main() -> None:
     # -- cluster: kill-a-worker failover under overload (own tiny model) -----
     if os.environ.get("BENCH_CLUSTER", "1") != "0":
         _run_phase(detail, "cluster", cluster_bench)
+        gc.collect()
+
+    # -- gateway: HTTP hop TTFT, constrained-mask cost, n fan-out HBM --------
+    if os.environ.get("BENCH_GATEWAY", "1") != "0":
+        _run_phase(detail, "gateway", gateway_bench)
         gc.collect()
 
     del params

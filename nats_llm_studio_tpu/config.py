@@ -237,6 +237,17 @@ class WorkerConfig:
     router_prefix_head_chars: int = field(
         default_factory=lambda: int(_env("ROUTER_PREFIX_HEAD_CHARS", "256"))
     )
+    # -- OpenAI-compatible HTTP/SSE gateway (gateway/server.py) ---------------
+    # bind address for ``python -m nats_llm_studio_tpu gateway``; loopback by
+    # default — exposing the front door beyond the host is an explicit choice
+    gateway_host: str = field(default_factory=lambda: _env("GATEWAY_HOST", "127.0.0.1"))
+    gateway_port: int = field(default_factory=lambda: int(_env("GATEWAY_PORT", "8080")))
+    # concurrent HTTP connections admitted before 503 (streaming responses
+    # hold a connection for their whole decode, so this bounds gateway RAM
+    # and protects the bus from connection storms)
+    gateway_max_conn: int = field(
+        default_factory=lambda: int(_env("GATEWAY_MAX_CONN", "256"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

@@ -26,8 +26,17 @@ CANDIDATES = 64  # static candidate cap for restricted (top-k/top-p) rows
 _NEG_INF = jnp.float32(-jnp.inf)
 
 
-def _pick(logits, gumbel, temperature, top_k, top_p) -> jax.Array:
-    """Shared sort-free selection. gumbel: [B, V] standard Gumbel noise."""
+def _pick(logits, gumbel, temperature, top_k, top_p, mask=None) -> jax.Array:
+    """Shared sort-free selection. gumbel: [B, V] standard Gumbel noise.
+
+    ``mask`` (optional [B, V] bool) bans tokens BEFORE truncation: banned
+    logits drop to -inf, so greedy argmax, full Gumbel-max, and the top-k /
+    top-p candidate set all operate on the already-constrained distribution
+    (constrained decoding stays distribution-exact over the allowed set).
+    ``mask=None`` takes the pre-existing code path untouched — unconstrained
+    sampling is bit-identical with or without this feature compiled in."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG_INF)
     b, v = logits.shape
     temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
@@ -64,11 +73,12 @@ def sample(
     temperature: jax.Array | float = 0.8,
     top_k: jax.Array | int = 0,  # 0 = disabled
     top_p: jax.Array | float = 1.0,
+    mask: jax.Array | None = None,  # [B, V] bool — False bans the token
 ) -> jax.Array:
     """Returns sampled token ids [B] int32. temperature <= 0 means greedy
     (per row). top-k and top-p are per-row arrays, not static."""
     gumbel = jax.random.gumbel(key, logits.shape, jnp.float32)
-    return _pick(logits, gumbel, temperature, top_k, top_p)
+    return _pick(logits, gumbel, temperature, top_k, top_p, mask=mask)
 
 
 def sample_rows(
@@ -78,6 +88,7 @@ def sample_rows(
     temperature: jax.Array | float = 0.8,
     top_k: jax.Array | int = 0,
     top_p: jax.Array | float = 1.0,
+    mask: jax.Array | None = None,  # [B, V] bool — False bans the token
 ) -> jax.Array:
     """Per-row deterministic sampling: row i's randomness depends only on
     (seeds[i], steps[i]), never on batch composition — a request replayed
@@ -89,7 +100,7 @@ def sample_rows(
         return jax.random.gumbel(k, (logits.shape[1],), jnp.float32)
 
     gumbel = jax.vmap(row_gumbel)(seeds, steps)
-    return _pick(logits, gumbel, temperature, top_k, top_p)
+    return _pick(logits, gumbel, temperature, top_k, top_p, mask=mask)
 
 
 # ---------------------------------------------------------------------------
@@ -97,12 +108,18 @@ def sample_rows(
 # ---------------------------------------------------------------------------
 
 
-def _log_weights(logits, temperature, top_k, top_p) -> jax.Array:
+def _log_weights(logits, temperature, top_k, top_p, mask=None) -> jax.Array:
     """Full-vocab log-weights ``w`` with softmax(w) equal to the
     distribution ``_pick`` draws from for temperature > 0 rows — same
     CANDIDATES cap, same top-k/top-p truncation rules, token for token.
     Non-selectable tokens sit at -inf. Greedy rows (temperature <= 0) are
-    the caller's job: their "distribution" is a point mass at argmax."""
+    the caller's job: their "distribution" is a point mass at argmax.
+
+    ``mask`` bans tokens before truncation, mirroring ``_pick`` — so spec
+    acceptance against a constrained sampler stays distribution-exact.
+    ``mask=None`` is the pre-existing code path, bit for bit."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG_INF)
     b, v = logits.shape
     temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
@@ -135,6 +152,7 @@ def spec_accept_rows(
     temperature: jax.Array | float = 0.8,
     top_k: jax.Array | int = 0,
     top_p: jax.Array | float = 1.0,
+    mask: jax.Array | None = None,  # [B, T, V] bool — per-position bans
 ) -> tuple[jax.Array, jax.Array]:
     """Rejection-sampling acceptance for prompt-lookup drafts.
 
@@ -159,6 +177,11 @@ def spec_accept_rows(
     ``tokens[b, :n_emit[b]]`` (accepted drafts then the resampled/bonus
     token); positions past n_emit hold zeros and carry no meaning.
     """
+    if mask is not None:
+        # ban before anything downstream: _log_weights truncation, greedy
+        # argmax, and residual resampling then all see the constrained
+        # distribution (identical to masking inside the plain sampler)
+        logits = jnp.where(mask, logits, _NEG_INF)
     b, t, v = logits.shape
     kd = t - 1
     temp_b = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))

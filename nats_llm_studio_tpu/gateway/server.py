@@ -1,0 +1,622 @@
+"""OpenAI-compatible HTTP/SSE front door over the NATS serving bus.
+
+``python -m nats_llm_studio_tpu gateway`` binds a plain asyncio HTTP/1.1
+server (no web framework — the container ships none) and translates:
+
+    POST /v1/chat/completions   -> ClusterRouter.request_chat[_stream]
+    GET  /v1/models             -> {prefix}.list_models
+    GET  /healthz               -> gateway + cluster-membership liveness
+
+so any unmodified OpenAI client (``openai`` SDK, curl, LangChain) can talk
+to a worker cluster without importing this package. Streaming responses are
+Server-Sent Events framed exactly like api.openai.com: one ``data: {chunk}``
+per delta, a final chunk carrying ``finish_reason``, then ``data: [DONE]``,
+with ``Connection: close`` delimiting the body.
+
+The gateway stays honest about the bus underneath it:
+
+* every request rides the steered router, so excluded-worker retry hops and
+  prefix-cache locality work exactly as for native NATS clients;
+* the caller's ``X-Deadline-Ms``/``X-Trace-Id`` headers pass through (and
+  are minted when absent), so budgets and traces span the HTTP hop;
+* a spent retry budget surfaces as a structured ``503`` with ``Retry-After``
+  (:class:`~..serve.router.RouterExhausted`), never a bare string;
+* a client that disconnects mid-stream tears the whole chain down: the SSE
+  writer aborts, the router stream closes, the transport publishes the
+  consumer-gone cancel, and the worker frees its batcher slot.
+
+``response_format`` is validated structurally HERE, before any bus traffic:
+a garbled value costs one JSON parse, not a worker round-trip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any
+
+from ..obs import new_trace_id
+from ..serve.constrain import validate_response_format
+from ..serve.router import ClusterRouter, RouterExhausted
+from ..transport import ConnectionClosedError, NatsClient, RetryPolicy
+from ..transport import protocol as p
+from ..transport.envelope import error_is_retryable
+
+log = logging.getLogger(__name__)
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 10 * 1024 * 1024
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    408: "Request Timeout",
+    411: "Length Required",
+    413: "Payload Too Large",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
+
+# OpenAI chat params the gateway forwards to the engine; everything else in
+# the request body is ignored (SDKs send fields this backend has no use
+# for — dropping them beats failing them)
+_FORWARDED_FIELDS = (
+    "model",
+    "messages",
+    "max_tokens",
+    "temperature",
+    "top_p",
+    "top_k",
+    "seed",
+    "stop",
+    "n",
+    "logprobs",
+    "top_logprobs",
+    "response_format",
+)
+
+
+class BadRequest(ValueError):
+    """Client-side payload error: rendered as HTTP 400 before any bus hop."""
+
+
+def translate_chat_payload(body: Any) -> tuple[dict, bool]:
+    """OpenAI ``/v1/chat/completions`` body -> (chat envelope, stream?).
+
+    Structural validation only — semantic limits (n vs slot count, schema
+    compilability against the tokenizer) belong to the serving worker.
+    Unknown fields are dropped; a missing ``max_tokens`` defers to the
+    engine default. Raises :class:`BadRequest` with a client-facing message.
+    """
+    if not isinstance(body, dict):
+        raise BadRequest("request body must be a JSON object")
+    model = body.get("model")
+    if not isinstance(model, str) or not model:
+        raise BadRequest("'model' must be a non-empty string")
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise BadRequest("'messages' must be a non-empty array")
+    for i, m in enumerate(messages):
+        if not isinstance(m, dict) or not isinstance(m.get("role"), str):
+            raise BadRequest(f"messages[{i}] must be an object with a 'role'")
+    # a garbled response_format must never reach the batcher: validate the
+    # structure here (the worker re-validates and also compiles the schema)
+    try:
+        validate_response_format(body.get("response_format"))
+    except ValueError as e:
+        raise BadRequest(str(e)) from e
+    for name in ("max_tokens", "max_completion_tokens", "n", "top_logprobs"):
+        v = body.get(name)
+        if v is not None and (isinstance(v, bool) or not isinstance(v, int)):
+            raise BadRequest(f"'{name}' must be an integer")
+    for name in ("temperature", "top_p"):
+        v = body.get(name)
+        if v is not None and (
+            isinstance(v, bool) or not isinstance(v, (int, float))
+        ):
+            raise BadRequest(f"'{name}' must be a number")
+    payload = {k: body[k] for k in _FORWARDED_FIELDS if body.get(k) is not None}
+    if "max_tokens" not in payload and body.get("max_completion_tokens") is not None:
+        payload["max_tokens"] = body["max_completion_tokens"]
+    stream = bool(body.get("stream"))
+    return payload, stream
+
+
+def _error_body(message: str, etype: str, code: str | None = None) -> dict:
+    return {
+        "error": {
+            "message": message,
+            "type": etype,
+            "param": None,
+            "code": code,
+        }
+    }
+
+
+def _status_for_error(err: str) -> tuple[int, str, str | None]:
+    """Map a worker error-envelope string to (status, type, code)."""
+    low = err.lower()
+    if "model not found" in low:
+        return 404, "invalid_request_error", "model_not_found"
+    if "invalid " in low:
+        return 400, "invalid_request_error", None
+    if "deadline exceeded" in low:
+        return 504, "timeout_error", "deadline_exceeded"
+    if error_is_retryable(err):
+        return 503, "overloaded_error", "worker_unavailable"
+    return 500, "api_error", None
+
+
+class Gateway:
+    """One HTTP front door. Owns (or borrows) a :class:`ClusterRouter`.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is available
+    as ``self.port`` after :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        nc: NatsClient,
+        *,
+        prefix: str = "lmstudio",
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        max_conn: int = 256,
+        chat_timeout_s: float = 120.0,
+        retry: RetryPolicy | None = None,
+        router: ClusterRouter | None = None,
+        stale_after_s: float = 5.0,
+        prefix_head_chars: int = 256,
+    ):
+        self.nc = nc
+        self.prefix = prefix
+        self.host = host
+        self.port = port
+        self.chat_timeout_s = chat_timeout_s
+        self.retry = retry or RetryPolicy(max_attempts=3, retry_on_timeout=True)
+        self._own_router = router is None
+        self.router = router or ClusterRouter(
+            nc,
+            prefix=prefix,
+            stale_after_s=stale_after_s,
+            prefix_head_chars=prefix_head_chars,
+        )
+        self._sem = asyncio.Semaphore(max(1, max_conn))
+        self._server: asyncio.base_events.Server | None = None
+        self.requests_total = 0
+        self.streams_total = 0
+        self.client_disconnects = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> "Gateway":
+        if self._own_router:
+            await self.router.start()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("gateway on http://%s:%d -> %s.*", self.host, self.port, self.prefix)
+        return self
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self._own_router:
+            await self.router.stop()
+
+    # -- HTTP plumbing -------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            if self._sem.locked():
+                await self._respond(
+                    writer, 503,
+                    _error_body("gateway connection limit reached",
+                                "overloaded_error", "gateway_overloaded"),
+                    extra={"Retry-After": "1"},
+                )
+                return
+            async with self._sem:
+                await self._handle_one(reader, writer)
+        except (ConnectionResetError, BrokenPipeError):
+            self.client_disconnects += 1
+        except Exception:  # noqa: BLE001 — one bad conn must not kill the server
+            log.exception("gateway: connection handler failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _handle_one(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.requests_total += 1
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return  # client went away before sending a request
+        except asyncio.LimitOverrunError:
+            await self._respond(
+                writer, 413, _error_body("headers too large", "invalid_request_error")
+            )
+            return
+        if len(head) > MAX_HEADER_BYTES:
+            await self._respond(
+                writer, 413, _error_body("headers too large", "invalid_request_error")
+            )
+            return
+        try:
+            request_line, headers = _parse_head(head)
+            method, target = request_line
+        except ValueError:
+            await self._respond(
+                writer, 400, _error_body("malformed HTTP request", "invalid_request_error")
+            )
+            return
+        path = target.split("?", 1)[0]
+
+        if method == "GET" and path == "/healthz":
+            await self._respond(writer, 200, {
+                "status": "ok",
+                "cluster_members": len(self.router.members()),
+                "requests_total": self.requests_total,
+            })
+            return
+        if method == "GET" and path == "/v1/models":
+            await self._get_models(writer)
+            return
+        if path == "/v1/chat/completions":
+            if method != "POST":
+                await self._respond(
+                    writer, 405,
+                    _error_body("use POST", "invalid_request_error"),
+                    extra={"Allow": "POST"},
+                )
+                return
+            body = await self._read_body(reader, writer, headers)
+            if body is None:
+                return
+            await self._chat(reader, writer, headers, body)
+            return
+        await self._respond(
+            writer, 404,
+            _error_body(f"no route for {method} {path}", "invalid_request_error"),
+        )
+
+    async def _read_body(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        headers: dict[str, str],
+    ) -> bytes | None:
+        """POST body via Content-Length (chunked uploads are refused — no
+        client this gateway targets sends them for JSON)."""
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            await self._respond(
+                writer, 411,
+                _error_body("chunked request bodies are not supported; "
+                            "send Content-Length", "invalid_request_error"),
+            )
+            return None
+        try:
+            length = int(headers.get("content-length", "0"))
+        except ValueError:
+            length = -1
+        if length < 0:
+            await self._respond(
+                writer, 400, _error_body("bad Content-Length", "invalid_request_error")
+            )
+            return None
+        if length > MAX_BODY_BYTES:
+            await self._respond(
+                writer, 413, _error_body("request body too large", "invalid_request_error")
+            )
+            return None
+        try:
+            return await reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return None
+
+    async def _respond(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        body: dict,
+        extra: dict[str, str] | None = None,
+    ) -> None:
+        raw = json.dumps(body, separators=(",", ":")).encode()
+        lines = [
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(raw)}",
+            "Connection: close",
+        ]
+        for k, v in (extra or {}).items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + raw)
+        await writer.drain()
+
+    # -- routes --------------------------------------------------------------
+
+    async def _get_models(self, writer: asyncio.StreamWriter) -> None:
+        try:
+            msg = await self.nc.request(
+                f"{self.prefix}.list_models", b"{}", timeout=30.0
+            )
+            env = json.loads(msg.payload or b"{}")
+        except (asyncio.TimeoutError, ConnectionClosedError, ValueError) as e:
+            await self._respond(
+                writer, 503,
+                _error_body(f"no worker answered list_models: {e}",
+                            "overloaded_error", "worker_unavailable"),
+                extra={"Retry-After": "1"},
+            )
+            return
+        if not env.get("ok"):
+            status, etype, code = _status_for_error(str(env.get("error", "")))
+            await self._respond(
+                writer, status, _error_body(str(env.get("error")), etype, code)
+            )
+            return
+        listing = (env.get("data") or {}).get("models") or {"object": "list", "data": []}
+        await self._respond(writer, 200, listing)
+
+    def _bus_headers(self, http_headers: dict[str, str]) -> dict[str, str]:
+        """NATS headers for this HTTP request: trace id and deadline budget
+        pass through from the client when stamped, minted otherwise."""
+        out = {p.TRACE_HEADER: http_headers.get(
+            p.TRACE_HEADER.lower(), new_trace_id()
+        )}
+        deadline = http_headers.get(p.DEADLINE_HEADER.lower())
+        if deadline:
+            out[p.DEADLINE_HEADER] = deadline
+        return out
+
+    async def _chat(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        http_headers: dict[str, str],
+        raw_body: bytes,
+    ) -> None:
+        try:
+            body = json.loads(raw_body or b"null")
+        except ValueError:
+            await self._respond(
+                writer, 400, _error_body("request body is not valid JSON",
+                                         "invalid_request_error")
+            )
+            return
+        try:
+            payload, stream = translate_chat_payload(body)
+        except BadRequest as e:
+            await self._respond(
+                writer, 400, _error_body(str(e), "invalid_request_error")
+            )
+            return
+        payload["stream"] = stream
+        bus_headers = self._bus_headers(http_headers)
+        if stream:
+            await self._chat_stream(reader, writer, payload, bus_headers)
+        else:
+            await self._chat_once(writer, payload, bus_headers)
+
+    async def _chat_once(
+        self, writer: asyncio.StreamWriter, payload: dict, bus_headers: dict[str, str]
+    ) -> None:
+        try:
+            msg = await self.router.request_chat(
+                payload,
+                timeout=self.chat_timeout_s,
+                headers=bus_headers,
+                retry=self.retry,
+                raise_on_exhausted=True,
+            )
+            env = json.loads(msg.payload or b"{}")
+        except RouterExhausted as e:
+            await self._respond_exhausted(writer, e)
+            return
+        except (asyncio.TimeoutError, ConnectionClosedError) as e:
+            await self._respond(
+                writer, 503,
+                _error_body(f"no worker answered: {e}", "overloaded_error",
+                            "worker_unavailable"),
+                extra={"Retry-After": "1"},
+            )
+            return
+        except ValueError:
+            await self._respond(
+                writer, 500, _error_body("worker reply was not JSON", "api_error")
+            )
+            return
+        if not env.get("ok"):
+            status, etype, code = _status_for_error(str(env.get("error", "")))
+            extra = {"Retry-After": "1"} if status == 503 else None
+            await self._respond(
+                writer, status,
+                _error_body(str(env.get("error")), etype, code), extra=extra,
+            )
+            return
+        response = (env.get("data") or {}).get("response") or {}
+        response.setdefault("id", f"chatcmpl-{bus_headers[p.TRACE_HEADER]}")
+        response.setdefault("created", int(time.time()))
+        await self._respond(writer, 200, response)
+
+    async def _respond_exhausted(
+        self, writer: asyncio.StreamWriter, e: RouterExhausted
+    ) -> None:
+        retry_after = max(1, int(e.retry_after_s + 0.999))
+        body = _error_body(e.detail(), "overloaded_error", "worker_unavailable")
+        body["error"]["retry_after_s"] = retry_after
+        if e.worker_id:
+            body["error"]["last_worker"] = e.worker_id
+        await self._respond(
+            writer, 503, body, extra={"Retry-After": str(retry_after)}
+        )
+
+    # -- SSE streaming -------------------------------------------------------
+
+    async def _chat_stream(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        payload: dict,
+        bus_headers: dict[str, str],
+    ) -> None:
+        self.streams_total += 1
+        chat_id = f"chatcmpl-{bus_headers[p.TRACE_HEADER]}"
+        created = int(time.time())
+        agen = self.router.request_chat_stream(
+            payload,
+            timeout=self.chat_timeout_s,
+            headers=bus_headers,
+            retry=self.retry,
+            raise_on_exhausted=True,
+        )
+        # any bytes (or EOF) from the client after the request mean it is
+        # gone — SSE clients never write. Racing the watcher against each
+        # bus message makes a mid-stream disconnect cancel the slot within
+        # one chunk instead of at socket-buffer pressure.
+        eof_task = asyncio.ensure_future(reader.read(1))
+        preamble_sent = False
+        disconnected = False
+        try:
+            while True:
+                step = asyncio.ensure_future(agen.__anext__())
+                await asyncio.wait({step, eof_task}, return_when=asyncio.FIRST_COMPLETED)
+                if eof_task.done() and not step.done():
+                    step.cancel()
+                    try:
+                        await step
+                    except BaseException:  # noqa: BLE001 — cancelled anext
+                        pass
+                    disconnected = True
+                    break
+                try:
+                    msg = await step
+                except StopAsyncIteration:
+                    break
+                except RouterExhausted as e:
+                    if not preamble_sent:
+                        await self._respond_exhausted(writer, e)
+                        return
+                    raise
+                except (asyncio.TimeoutError, ConnectionClosedError) as e:
+                    if not preamble_sent:
+                        await self._respond(
+                            writer, 503,
+                            _error_body(f"no worker answered: {e}",
+                                        "overloaded_error", "worker_unavailable"),
+                            extra={"Retry-After": "1"},
+                        )
+                        return
+                    raise
+                terminal = bool(msg.headers and "Nats-Stream-Done" in msg.headers)
+                try:
+                    env = json.loads(msg.payload or b"{}")
+                except ValueError:
+                    env = {}
+                if terminal:
+                    if not env.get("ok"):
+                        err = str(env.get("error", "stream failed"))
+                        if not preamble_sent:
+                            status, etype, code = _status_for_error(err)
+                            extra = {"Retry-After": "1"} if status == 503 else None
+                            await self._respond(
+                                writer, status, _error_body(err, etype, code),
+                                extra=extra,
+                            )
+                            return
+                        # headers are gone: surface the error in-band, the
+                        # way api.openai.com does mid-stream
+                        await self._sse(writer, {"error": _error_body(
+                            err, *_status_for_error(err)[1:])["error"]})
+                        break
+                    response = (env.get("data") or {}).get("response") or {}
+                    if not preamble_sent:
+                        await self._sse_preamble(writer)
+                        preamble_sent = True
+                    for choice in response.get("choices") or [{}]:
+                        fin = {
+                            "id": chat_id,
+                            "object": "chat.completion.chunk",
+                            "created": created,
+                            "model": payload.get("model", ""),
+                            "choices": [{
+                                "index": choice.get("index", 0),
+                                "delta": {},
+                                "finish_reason": choice.get("finish_reason", "stop"),
+                            }],
+                        }
+                        if response.get("usage"):
+                            fin["usage"] = response["usage"]
+                        await self._sse(writer, fin)
+                    break
+                chunk = (env.get("data") or {}).get("chunk")
+                if not isinstance(chunk, dict):
+                    continue
+                chunk.setdefault("id", chat_id)
+                chunk.setdefault("created", created)
+                if not preamble_sent:
+                    await self._sse_preamble(writer)
+                    preamble_sent = True
+                await self._sse(writer, chunk)
+            if preamble_sent and not disconnected:
+                writer.write(b"data: [DONE]\n\n")
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            disconnected = True
+        finally:
+            eof_task.cancel()
+            try:
+                await eof_task
+            except BaseException:  # noqa: BLE001
+                pass
+            # closing the router stream propagates consumer-gone down the
+            # transport: the worker sees <inbox>.cancel and frees the slot
+            await agen.aclose()
+            if disconnected:
+                self.client_disconnects += 1
+
+    @staticmethod
+    async def _sse_preamble(writer: asyncio.StreamWriter) -> None:
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n"
+            b"\r\n"
+        )
+        await writer.drain()
+
+    @staticmethod
+    async def _sse(writer: asyncio.StreamWriter, obj: dict) -> None:
+        writer.write(b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n")
+        await writer.drain()
+
+
+def _parse_head(head: bytes) -> tuple[tuple[str, str], dict[str, str]]:
+    """(method, target), lower-cased header dict — or ValueError."""
+    lines = head.decode("latin-1").split("\r\n")
+    parts = lines[0].split(" ")
+    if len(parts) != 3 or not parts[2].startswith("HTTP/1."):
+        raise ValueError(f"bad request line: {lines[0]!r}")
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        k, sep, v = line.partition(":")
+        if not sep:
+            raise ValueError(f"bad header line: {line!r}")
+        headers[k.strip().lower()] = v.strip()
+    return (parts[0], parts[1]), headers

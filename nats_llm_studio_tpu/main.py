@@ -8,6 +8,7 @@ fact"). This CLI is that wiring, made first-class:
     python -m nats_llm_studio_tpu serve --embedded-broker [--port 4222]
     python -m nats_llm_studio_tpu broker --port 4222 [--store-dir ./nats_data]
     python -m nats_llm_studio_tpu route                # standalone cluster router
+    python -m nats_llm_studio_tpu gateway [--port 8080]  # OpenAI-compatible HTTP front door
     python -m nats_llm_studio_tpu publish <model.gguf> <publisher>/<name>
     python -m nats_llm_studio_tpu chat <model_id> "prompt..."
 
@@ -165,6 +166,38 @@ async def _run_route(args: argparse.Namespace) -> None:
     await nc.close()
 
 
+async def _run_gateway(args: argparse.Namespace) -> None:
+    """OpenAI-compatible HTTP/SSE front door (gateway/server.py): serves
+    /v1/chat/completions, /v1/models, and /healthz over the steered cluster
+    router, so unmodified OpenAI clients reach the worker cluster."""
+    from .gateway import Gateway
+    from .transport import RetryPolicy, connect
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url, name="tpu-gateway")
+    gw = Gateway(
+        nc,
+        prefix=cfg.subject_prefix,
+        host=args.host or cfg.gateway_host,
+        port=cfg.gateway_port if args.port is None else args.port,
+        max_conn=cfg.gateway_max_conn,
+        chat_timeout_s=cfg.chat_timeout_s,
+        retry=RetryPolicy(max_attempts=args.max_attempts, retry_on_timeout=True),
+        stale_after_s=cfg.router_stale_after_s,
+        prefix_head_chars=cfg.router_prefix_head_chars,
+    )
+    await gw.start()
+    log.info("gateway on http://%s:%d (bus %s, prefix %s)",
+             gw.host, gw.port, cfg.nats_url, cfg.subject_prefix)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await gw.stop()
+    await nc.close()
+
+
 async def _run_publish(args: argparse.Namespace) -> None:
     from .store import ModelStore
     from .transport import connect
@@ -233,6 +266,11 @@ def main(argv: list[str] | None = None) -> None:
     rp = sub.add_parser("route", help="run a standalone cluster router")
     rp.add_argument("--max-attempts", type=int, default=3)
 
+    gw = sub.add_parser("gateway", help="run the OpenAI-compatible HTTP gateway")
+    gw.add_argument("--host", default=None)
+    gw.add_argument("--port", type=int, default=None)
+    gw.add_argument("--max-attempts", type=int, default=3)
+
     pp = sub.add_parser("publish", help="import a GGUF and upload it to the bucket")
     pp.add_argument("gguf")
     pp.add_argument("model_id")
@@ -249,6 +287,7 @@ def main(argv: list[str] | None = None) -> None:
         "serve": _run_serve,
         "broker": _run_broker,
         "route": _run_route,
+        "gateway": _run_gateway,
         "publish": _run_publish,
         "chat": _run_chat,
     }[args.cmd]

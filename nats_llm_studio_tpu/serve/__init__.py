@@ -1,5 +1,11 @@
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
-from .router import ClusterRouter, RouterProcess, WorkerAdvert, prompt_head_hash
+from .router import (
+    ClusterRouter,
+    RouterExhausted,
+    RouterProcess,
+    WorkerAdvert,
+    prompt_head_hash,
+)
 from .worker import Worker
 
 __all__ = [
@@ -8,6 +14,7 @@ __all__ = [
     "EngineError",
     "ModelNotFound",
     "Registry",
+    "RouterExhausted",
     "RouterProcess",
     "Worker",
     "WorkerAdvert",

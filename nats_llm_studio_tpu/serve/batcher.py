@@ -65,6 +65,10 @@ log = logging.getLogger(__name__)
 # tokens for it nor let another admit claim the slot
 _RESERVED = object()
 
+# how many top-logprob (id, logprob) pairs the ext decode programs read back
+# per step; OpenAI caps top_logprobs requests well below this
+LOGPROBS_K = 8
+
 
 class BatcherStopped(RuntimeError):
     """Submit raced a shutdown (drain, or idle-eviction by the registry's
@@ -111,6 +115,23 @@ class _Request:
     # distinguishes a deadline abort from a consumer-gone cancel when the
     # owner thread frees the slot (cause tag in cancel_causes/prometheus)
     deadline_hit: bool = False
+    # -- constrained decoding / logprobs (the "ext" regime) ---------------
+    # TokenDFA (serve/constrain.py) when response_format demands schema-
+    # constrained output; cstate is the current DFA state, advanced on the
+    # host at readback (the device only sees the per-state vocab mask)
+    constrain: object | None = None
+    cstate: int = 0
+    want_logprobs: bool = False
+    top_logprobs: int = 0
+    # the rewind trick: an ext admit suppresses the fused-admit first token,
+    # steps pos back one, and re-processes prompt[-1] through the masked ext
+    # program — so token 0 obeys the mask and carries logprobs like every
+    # later token, without a separate masked-prefill program family
+    rewound: bool = False
+
+    @property
+    def is_ext(self) -> bool:
+        return self.constrain is not None or self.want_logprobs
 
     def emit(self, kind: str, value) -> None:
         self.loop.call_soon_threadsafe(self.out.put_nowait, (kind, value))
@@ -818,6 +839,29 @@ class ContinuousBatcher:
             )
             return toks.T, pin_cache(K), pin_cache(V), tok, pos + n, steps + n
 
+        @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11,))
+        def decode_pos_ext(params, tok, K, V, pos, seeds, steps, temp, topk,
+                           topp, mask, window):
+            """Single masked positional decode step with logprob readback —
+            the "ext" regime program, dispatched whenever any live slot
+            needs constrained decoding or logprobs. ``mask`` [B, V] bans
+            tokens before truncation inside sample_rows; all-True rows are
+            a bitwise no-op, so normal slots ride along unchanged. n is
+            fixed at 1: the mask for step i+1 depends on the token chosen
+            at step i (a host-side DFA walk), so bursts cannot scan."""
+            logits, K, V = fwd(
+                params, tokens=tok[:, None], k_cache=pin_cache(K),
+                v_cache=pin_cache(V), start_pos=pos, attn_window=window,
+            )
+            raw = logits[:, -1, :]
+            nxt = sample_rows(raw, seeds, steps, temp, topk, topp, mask=mask)
+            logp = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
+            chosen = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+            kk = min(LOGPROBS_K, raw.shape[-1])
+            top_lp, top_ids = jax.lax.top_k(logp, kk)
+            return (nxt, chosen, top_ids, top_lp, pin_cache(K), pin_cache(V),
+                    nxt, pos + 1, steps + 1)
+
         @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(12,))
         def spec_verify(params, tok, K, V, pos, drafts, dlen, seeds, steps,
                         temp, topk, topp, window):
@@ -1048,6 +1092,32 @@ class ContinuousBatcher:
                 VP = pin_pool(kv_pool_scatter_view(VP, Vv, tbl_n, vb))
                 return toks.T, KP, VP, tok, pos + n, steps + n
 
+            @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(12,))
+            def decode_pos_paged_ext(params, tok, KP, VP, tbl, pos, seeds,
+                                     steps, temp, topk, topp, mask, nb):
+                """Paged twin of decode_pos_ext: one masked step with
+                logprob readback through the gather-view / scatter-back
+                frame. Same n=1 constraint (next mask needs this token)."""
+                tbl_n = jax.lax.slice_in_dim(tbl, 0, nb, axis=1)
+                Kv = pin_row(kv_pool_gather_view(KP, tbl_n))
+                Vv = pin_row(kv_pool_gather_view(VP, tbl_n))
+                logits, Kv, Vv = fwd(
+                    params, tokens=tok[:, None], k_cache=Kv, v_cache=Vv,
+                    start_pos=pos,
+                )
+                raw = logits[:, -1, :]
+                nxt = sample_rows(raw, seeds, steps, temp, topk, topp,
+                                  mask=mask)
+                logp = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
+                chosen = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+                kk = min(LOGPROBS_K, raw.shape[-1])
+                top_lp, top_ids = jax.lax.top_k(logp, kk)
+                vb = _touched(pos, 1, nb)
+                KP = pin_pool(kv_pool_scatter_view(KP, Kv, tbl_n, vb))
+                VP = pin_pool(kv_pool_scatter_view(VP, Vv, tbl_n, vb))
+                return (nxt, chosen, top_ids, top_lp, KP, VP, nxt, pos + 1,
+                        steps + 1)
+
             @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(13,))
             def spec_verify_paged(params, tok, KP, VP, tbl, pos, drafts, dlen,
                                   seeds, steps, temp, topk, topp, nb):
@@ -1094,6 +1164,9 @@ class ContinuousBatcher:
             )
             self._fill_row_chunk = self._timed("fill_row_chunk", fill_row_chunk)
             self._decode_pos_paged = self._timed("decode_pos_paged", decode_pos_paged)
+            self._decode_pos_paged_ext = self._timed(
+                "decode_pos_paged_ext", decode_pos_paged_ext
+            )
             self._spec_verify_paged = self._timed("spec_verify_paged", spec_verify_paged)
             self._pool_copy_block = self._timed("pool_copy_block", pool_copy_block)
 
@@ -1108,6 +1181,7 @@ class ContinuousBatcher:
         self._finish_admit_group = self._timed("finish_admit_group", finish_admit_group)
         self._decode = self._timed("decode", decode)
         self._decode_pos = self._timed("decode_pos", decode_pos)
+        self._decode_pos_ext = self._timed("decode_pos_ext", decode_pos_ext)
         self._spec_verify = self._timed("spec_verify", spec_verify)
         self._compact_ring = self._timed("compact_ring", compact_ring)
 
@@ -1475,11 +1549,25 @@ class ContinuousBatcher:
         sp: SamplingParams,
         trace: Trace | None = None,
         deadline: float | None = None,
+        constrain=None,
+        want_logprobs: bool = False,
+        top_logprobs: int = 0,
     ) -> _Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if len(prompt_ids) >= self.max_seq:
             raise ValueError(f"prompt of {len(prompt_ids)} tokens >= max_seq {self.max_seq}")
+        if (constrain is not None or want_logprobs) and not (
+            self.paged or self.spec_cfg is not None
+        ):
+            # the rewind trick re-processes prompt[-1] at its own sequence
+            # position — only the positional layouts can do that; the legacy
+            # ring writes at a shared ring head and would corrupt the cache
+            raise ValueError(
+                "constrained decoding / logprobs require the positional KV "
+                "layout (paged KV or spec decode); KV_PAGED=0 without spec "
+                "cannot serve them"
+            )
         req = _Request(
             prompt_ids=list(prompt_ids),
             sp=sp,
@@ -1488,6 +1576,10 @@ class ContinuousBatcher:
             t_enq=time.monotonic(),
             trace=trace,
             deadline=deadline,
+            constrain=constrain,
+            cstate=constrain.start if constrain is not None else 0,
+            want_logprobs=want_logprobs or top_logprobs > 0,
+            top_logprobs=int(top_logprobs),
         )
         if trace is not None:
             trace.mark("enqueue", req.t_enq)
@@ -1555,6 +1647,9 @@ class ContinuousBatcher:
         info: dict | None = None,
         trace: Trace | None = None,
         deadline: float | None = None,
+        constrain=None,
+        want_logprobs: bool = False,
+        top_logprobs: int = 0,
     ) -> AsyncIterator[int]:
         """Yield generated token ids for one request.
 
@@ -1565,7 +1660,9 @@ class ContinuousBatcher:
         (the client's propagated budget): past it the request is shed before
         prefill or cooperatively aborted mid-decode."""
         async for batch in self.submit_batched(
-            prompt_ids, sp, info=info, trace=trace, deadline=deadline
+            prompt_ids, sp, info=info, trace=trace, deadline=deadline,
+            constrain=constrain, want_logprobs=want_logprobs,
+            top_logprobs=top_logprobs,
         ):
             for tok in batch:
                 yield tok
@@ -1577,18 +1674,31 @@ class ContinuousBatcher:
         info: dict | None = None,
         trace: Trace | None = None,
         deadline: float | None = None,
-    ) -> AsyncIterator[list[int]]:
+        constrain=None,
+        want_logprobs: bool = False,
+        top_logprobs: int = 0,
+    ) -> AsyncIterator[list]:
         """Like ``submit`` but yields LISTS of tokens: everything already
         delivered when the consumer wakes comes out as one batch. A decode
         burst lands on the event loop as ``decode_burst`` tokens at once,
         so the streaming layer can publish one NATS chunk per burst instead
         of per token — at 64+ concurrent streams the per-message publish
-        overhead is a measurable share of served throughput."""
+        overhead is a measurable share of served throughput.
+
+        ``constrain`` is a serve/constrain.py TokenDFA (schema-constrained
+        decoding); ``want_logprobs``/``top_logprobs`` switch each batch item
+        from a bare token id to a ``(tok, logprob, top_ids, top_logprobs)``
+        tuple. Either option routes the request through the single-step
+        masked ext decode program."""
         if not self._started:
             self.start()
         if not prompt_ids:
             return
-        req = self._enqueue(prompt_ids, sp, trace=trace, deadline=deadline)
+        req = self._enqueue(
+            prompt_ids, sp, trace=trace, deadline=deadline,
+            constrain=constrain, want_logprobs=want_logprobs,
+            top_logprobs=top_logprobs,
+        )
         done = False
         try:
             while True:
@@ -1803,6 +1913,7 @@ class ContinuousBatcher:
 
         # in-flight dispatches whose results have not been read back:
         # ("decode", toks_ref, n, [(slot, req), ...]) |
+        # ("ext", toks, lps, top_ids, top_lps, [(slot, req), ...], t) |
         # ("admit", firsts_ref, [(row_in_firsts, slot, req), ...])
         inflight: collections.deque = collections.deque()
 
@@ -1814,6 +1925,16 @@ class ContinuousBatcher:
             return [
                 i for i, r in enumerate(self._slots) if isinstance(r, _Request)
             ]
+
+        def ext_live() -> bool:
+            # any live constrained/logprob slot forces the ext regime: the
+            # burst/spec programs advance the device pos carry for EVERY
+            # row, so an ext slot cannot sit out a normal dispatch — all
+            # decode goes through the masked single-step program until the
+            # last ext slot finishes
+            return any(
+                isinstance(r, _Request) and r.is_ext for r in self._slots
+            )
 
         def finish_slot(i: int) -> None:
             self._slots[i] = None
@@ -1863,6 +1984,7 @@ class ContinuousBatcher:
             it must not escape to the dispatch-failure reset and kill every
             healthy stream (the K/V buffers are fine; only np.asarray
             readback errors mean poisoned device state)."""
+            nonlocal tok_dev, dirty
             if rec[0] == "decode":
                 _, toks_ref, n, rows, t_disp = rec
                 ids = np.asarray(toks_ref)  # ONE [B, n] readback per burst
@@ -1938,6 +2060,57 @@ class ContinuousBatcher:
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
                         finish_slot(slot)
+            elif rec[0] == "ext":
+                _, toks_ref, lp_ref, topids_ref, toplps_ref, rows, t_disp = rec
+                ids = np.asarray(toks_ref)  # [B]
+                lps = np.asarray(lp_ref)  # [B]
+                tis = np.asarray(topids_ref)  # [B, LOGPROBS_K]
+                tls = np.asarray(toplps_ref)  # [B, LOGPROBS_K]
+                step_s = time.monotonic() - t_disp
+                self.stats.decode_step_ms.record(step_s * 1e3)
+                self._note_decode_spt(step_s)
+                for slot, req in rows:
+                    if self._slots[slot] is not req:
+                        continue
+                    if req.cancelled:
+                        finish_slot(slot)
+                        self.stats.record_cancel(
+                            "deadline" if req.deadline_hit else "decode"
+                        )
+                        continue
+                    st = spec_slots[slot]
+                    try:
+                        req.pos += 1
+                        t = int(ids[slot])
+                        if st is not None:
+                            st.index.append(t)  # normal slot riding along
+                        dead = False
+                        if req.constrain is not None:
+                            nstate = req.constrain.advance(req.cstate, t)
+                            if nstate is not None:
+                                # (None only for an EOS outside an accept
+                                # state, which the mask already forbids —
+                                # _deliver maps stop ids to "stop" below)
+                                req.cstate = nstate
+                            dead = not req.constrain.live(req.cstate)
+                        if req.want_logprobs:
+                            reason = self._deliver(
+                                req, t, logprob=float(lps[slot]),
+                                top_ids=tis[slot].tolist(),
+                                top_lps=tls[slot].tolist(),
+                            )
+                        else:
+                            reason = self._deliver(req, t)
+                        if reason is None and dead:
+                            # the DFA can extend the document no further:
+                            # the constrained output is complete
+                            reason = "stop"
+                        if reason is not None:
+                            finish_slot(slot)  # free BEFORE the end event
+                            req.emit("end", reason)
+                    except Exception:  # noqa: BLE001 — dead client
+                        log.exception("delivery failed; dropping slot %d", slot)
+                        finish_slot(slot)
             else:
                 _, firsts_ref, rows = rec
                 ids = np.asarray(firsts_ref)
@@ -1949,6 +2122,25 @@ class ContinuousBatcher:
                         self.stats.record_cancel(
                             "deadline" if req.deadline_hit else "admit"
                         )
+                        continue
+                    if req.is_ext and not req.rewound:
+                        # the rewind trick: the fused admit sampled token 0
+                        # without mask or logprob readback — drop it, step
+                        # the slot back one position, and put prompt[-1]
+                        # back on the device carry. The next ext step
+                        # re-processes prompt[-1] at position n-1 (the KV
+                        # write repeats identical values; CoW privatizes any
+                        # shared block first) and samples the REAL first
+                        # token under the mask. host_steps resets to 0 so
+                        # the delivered token 0 consumes rng (seed, step 0)
+                        # exactly like an unconstrained first token would.
+                        req.rewound = True
+                        host_pos[slot] -= 1
+                        host_steps[slot] = 0
+                        tok_dev = tok_dev.at[slot].set(
+                            jnp.int32(req.prompt_ids[-1])
+                        )
+                        dirty = True
                         continue
                     try:
                         first = int(ids[row])
@@ -2117,6 +2309,58 @@ class ContinuousBatcher:
                 host_steps[i] += n
             inflight.append(
                 ("decode", toks, n, [(i, self._slots[i]) for i in act], time.monotonic())
+            )
+
+        def decode_ext_once() -> None:
+            """Dispatch ONE masked single-step decode covering every active
+            slot (the ext regime). Constrained rows carry their DFA state's
+            vocab mask; every other row gets all-True (a bitwise no-op
+            inside _pick). Single-step because the mask for step i+1 is a
+            host-side DFA walk over the token chosen at step i — the caller
+            runs depth-0 (pump(0) before and after) for the same reason."""
+            nonlocal K, V, tok_dev, dirty
+            nonlocal pos_dev, steps_dev, seeds_dev
+            act = active()
+            if not act:
+                return
+            refresh_rows()
+            mask = np.ones((B, cfg.vocab_size), dtype=bool)
+            for i in act:
+                r = self._slots[i]
+                if isinstance(r, _Request) and r.constrain is not None:
+                    dm = r.constrain.mask(r.cstate)
+                    mask[i, :] = False
+                    mask[i, : dm.shape[0]] = dm
+            mask_dev = jnp.asarray(mask)
+            if paged:
+                for i in act:
+                    ensure_blocks(i, min(host_pos[i] + 1, self.max_seq))
+                    ensure_private(i, host_pos[i], host_pos[i] + 1)
+                refresh_tables()
+                nb = paged_window(max(host_pos[i] for i in act) + 2)
+                (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
+                 steps_dev) = self._decode_pos_paged_ext(
+                    self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                    seeds_dev, steps_dev, temp, topk, topp, mask_dev, nb,
+                    _tokens=len(act),
+                )
+            else:
+                w = self._win_bucket(max(host_pos[i] for i in act) + 2)
+                window = w if w < self.max_seq else None
+                (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
+                 steps_dev) = self._decode_pos_ext(
+                    self.params, tok_dev, K, V, pos_dev,
+                    seeds_dev, steps_dev, temp, topk, topp, mask_dev, window,
+                    _tokens=len(act),
+                )
+            self.stats.steps += 1
+            self.stats.tokens_per_step.record(float(len(act)))
+            for i in act:
+                host_pos[i] += 1
+                host_steps[i] += 1
+            inflight.append(
+                ("ext", toks, lps, top_ids, top_lps,
+                 [(i, self._slots[i]) for i in act], time.monotonic())
             )
 
         def spec_once() -> bool:
@@ -2328,7 +2572,7 @@ class ContinuousBatcher:
                         )
                         if start + C <= n:
                             chunk_logits[start // C] = logits
-                        if start + C < n:
+                        if start + C < n and not ext_live():
                             decode_once()
                             pump()
                     skip = p // C
@@ -2361,7 +2605,7 @@ class ContinuousBatcher:
                         )
                         if chunk_logits is not None and start + C <= n:
                             chunk_logits[start // C] = logits
-                        if start + C < n:
+                        if start + C < n and not ext_live():
                             decode_once()
                             pump()
                     skip = 0
@@ -2505,7 +2749,7 @@ class ContinuousBatcher:
                                 )
                                 if start + C <= n:
                                     chunk_logits[start // C] = logits
-                                if start + C < n:
+                                if start + C < n and not ext_live():
                                     decode_once()
                                     pump()
                         harvest_prefix(
@@ -2543,7 +2787,7 @@ class ContinuousBatcher:
                             )
                             if chunk_logits is not None and start + C <= n:
                                 chunk_logits[start // C] = logits
-                            if start + C < n:
+                            if start + C < n and not ext_live():
                                 decode_once()
                                 pump()
                         harvest_prefix(req.prompt_ids, k1, v1, 0, chunk_logits)
@@ -2782,7 +3026,7 @@ class ContinuousBatcher:
                     )
                     if glogits is not None:
                         glogits.append(logits)
-                    if start + C < max(ns):
+                    if start + C < max(ns) and not ext_live():
                         decode_once()
                         pump()
                 if paged:
@@ -3120,6 +3364,7 @@ class ContinuousBatcher:
                         and len(group) < cap
                         and not waitlist
                         and coalesce_s > 0
+                        and not ext_live()
                     ):
                         if active():
                             # guarded like every other dispatch site: a
@@ -3253,7 +3498,19 @@ class ContinuousBatcher:
                 ):
                     pump(0)
                 maybe_compact()
-                if (
+                if ext_live():
+                    # ext regime: a constrained/logprob slot advances one
+                    # masked step at a time, and the burst/spec programs
+                    # would advance the device pos carry of EVERY row —
+                    # so while any ext slot is live, all slots decode
+                    # through the masked single-step program. pump(0)
+                    # first so an ext admit's rewind lands before its
+                    # first masked step; pump(0) after so the DFA state
+                    # advances before the next mask is built.
+                    pump(0)
+                    decode_ext_once()
+                    pump(0)
+                elif (
                     spec is not None
                     and 0 < len(active()) <= spec.max_active
                     and not (bo is not None and bo.pause_spec)
@@ -3277,13 +3534,22 @@ class ContinuousBatcher:
             except Exception:  # noqa: BLE001 — K/V were donated; must reset
                 reset_after_failed_dispatch()
 
-    def _deliver(self, req: _Request, tok_id: int) -> str | None:
+    def _deliver(
+        self,
+        req: _Request,
+        tok_id: int,
+        logprob: float | None = None,
+        top_ids: list | None = None,
+        top_lps: list | None = None,
+    ) -> str | None:
         """Push one token; returns the end reason when the request just
         finished, else None. The END event is NOT emitted here — the caller
         frees the slot first, then emits, so a consumer observing "end" can
         rely on the slot (and the batcher's ``idle`` view) being current
         (the registry's idle-eviction check reads it immediately after a
-        chat returns)."""
+        chat returns). Requests with ``want_logprobs`` receive
+        ``(tok, logprob, top_ids, top_logprobs)`` tuples instead of bare
+        ids (the ext readback supplies the extra fields)."""
         if tok_id in req.sp.stop_ids:
             if req.trace is not None:
                 req.trace.mark("decode_done")
@@ -3300,7 +3566,10 @@ class ContinuousBatcher:
                 self._note_prefill_rate(len(req.prompt_ids), now - req.t_admit)
             if req.trace is not None:
                 req.trace.mark("first_token", now)
-        req.emit("tok", tok_id)
+        if req.want_logprobs:
+            req.emit("tok", (tok_id, logprob, top_ids, top_lps))
+        else:
+            req.emit("tok", tok_id)
         if req.generated >= req.sp.max_tokens or req.pos + 1 >= self.max_seq:
             if req.trace is not None:
                 req.trace.mark("decode_done")

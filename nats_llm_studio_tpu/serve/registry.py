@@ -12,6 +12,7 @@ import asyncio
 import logging
 import os
 import time
+from dataclasses import replace as dc_replace
 from typing import Any, AsyncIterator
 
 import jax
@@ -26,9 +27,16 @@ from ..obs import emit as obs_emit
 from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
 from ..utils.nuid import next_nuid
+from . import constrain as constrain_mod
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
-from .batcher import BatcherOverloaded, BatcherStopped, ContinuousBatcher
+from .batcher import (
+    LOGPROBS_K,
+    BatcherOverloaded,
+    BatcherStopped,
+    ContinuousBatcher,
+)
 from .brownout import BrownoutConfig
+from .constrain import ConstraintError, compile_token_dfa, validate_response_format
 from .template import render_chat_template, stop_token_ids
 
 log = logging.getLogger(__name__)
@@ -210,21 +218,22 @@ class JaxChatEngine(ChatEngine):
         return self.tokenizer.encode(prompt)
 
     def _completion(self, text: str, n_prompt: int, n_out: int, finish: str,
-                    stats=None) -> dict:
+                    stats=None, logprobs=None) -> dict:
         """OpenAI-style body with LM Studio's stats block
         (/root/reference/README.md:208-231)."""
+        choice: dict[str, Any] = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish,
+        }
+        if logprobs is not None:
+            choice["logprobs"] = logprobs
         out: dict[str, Any] = {
             "id": f"chatcmpl-{next_nuid()[:12].lower()}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": self.model_id,
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": finish,
-                }
-            ],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": n_prompt,
                 "completion_tokens": n_out,
@@ -239,6 +248,27 @@ class JaxChatEngine(ChatEngine):
             }
         return out
 
+    def _lp_entry(self, item: tuple, top_n: int) -> dict:
+        """One OpenAI ``logprobs.content`` element from a batcher
+        (tok, logprob, top_ids, top_logprobs) tuple."""
+        tok, lp, top_ids, top_lps = item
+        s = self.tokenizer.decode([int(tok)])
+        entry: dict[str, Any] = {
+            "token": s,
+            "logprob": float(lp) if lp is not None else 0.0,
+            "bytes": list(s.encode("utf-8")),
+            "top_logprobs": [],
+        }
+        if top_n and top_ids:
+            for tid, tlp in list(zip(top_ids, top_lps))[:top_n]:
+                ts = self.tokenizer.decode([int(tid)])
+                entry["top_logprobs"].append({
+                    "token": ts,
+                    "logprob": float(tlp),
+                    "bytes": list(ts.encode("utf-8")),
+                })
+        return entry
+
     # -- ChatEngine ----------------------------------------------------------
 
     async def chat(self, payload: dict) -> dict:
@@ -250,6 +280,125 @@ class JaxChatEngine(ChatEngine):
             else:
                 parts.append(chunk["choices"][0]["delta"].get("content", ""))
         return final if final is not None else self._completion("".join(parts), 0, 0, "stop")
+
+    def _parse_ext(self, payload: dict):
+        """Parse the engine-layer OpenAI extensions out of the payload:
+        returns (token_dfa, want_logprobs, top_logprobs, n_choices).
+        Raises EngineError with a client-facing message on bad values —
+        the worker envelope carries it back as a 400-shaped error."""
+        try:
+            schema = validate_response_format(payload.get("response_format"))
+        except ValueError as e:
+            raise EngineError(f"invalid response_format: {e}") from e
+        dfa = None
+        if schema is not None:
+            if not constrain_mod.enabled():
+                raise EngineError(
+                    "invalid response_format: constrained decoding is "
+                    "disabled on this worker (CONSTRAIN=0)"
+                )
+            try:
+                dfa = compile_token_dfa(
+                    schema, self.tokenizer, self.cfg.vocab_size,
+                    eos_ids=self._stop_ids,
+                )
+            except ConstraintError as e:
+                raise EngineError(f"invalid response_format: {e}") from e
+        try:
+            top_n = int(payload.get("top_logprobs") or 0)
+            n_choices = int(payload.get("n") or 1)
+        except (TypeError, ValueError) as e:
+            raise EngineError(f"invalid request: {e}") from e
+        if not 0 <= top_n <= LOGPROBS_K:
+            raise EngineError(
+                f"invalid top_logprobs: must be between 0 and {LOGPROBS_K}"
+            )
+        want_lp = bool(payload.get("logprobs")) or top_n > 0
+        if not 1 <= n_choices <= self.batcher.max_slots:
+            raise EngineError(
+                f"invalid n: must be between 1 and {self.batcher.max_slots}"
+            )
+        return dfa, want_lp, top_n, n_choices
+
+    async def _stream_one(
+        self, index: int, prompt_ids: list[int], sp: SamplingParams,
+        trace, deadline, dfa, want_lp: bool, top_n: int, result: dict,
+    ) -> AsyncIterator[dict]:
+        """Drive ONE choice through the batcher: yields OpenAI chunk dicts
+        tagged with choice ``index`` and fills ``result`` with the
+        aggregate (text / finish / stats / logprobs) on clean completion."""
+        stats = GenStats(prompt_tokens=len(prompt_ids))
+        t0 = time.perf_counter()
+        toks: list[int] = []
+        lp_entries: list[dict] = []
+        pending_lp: list[dict] = []  # entries held with incomplete UTF-8 text
+        emitted = 0
+        end_info: dict = {}
+        # batched iteration: a decode burst's tokens land as ONE chunk
+        # message (the delta simply carries more text) — per-message
+        # publish overhead is a real share of throughput at 64+ streams
+        async for tok_batch in self.batcher.submit_batched(
+            prompt_ids, sp, info=end_info, trace=trace, deadline=deadline,
+            constrain=dfa, want_logprobs=want_lp, top_logprobs=top_n,
+        ):
+            if not toks:
+                stats.ttft_s = time.perf_counter() - t0
+            if want_lp:
+                # ext deliveries are (tok, logprob, top_ids, top_lps) tuples
+                entries = [self._lp_entry(t, top_n) for t in tok_batch]
+                lp_entries.extend(entries)
+                pending_lp.extend(entries)
+                tok_batch = [t[0] for t in tok_batch]
+            toks.extend(tok_batch)
+            stats.completion_tokens += len(tok_batch)
+            # decode incrementally; emit only completed UTF-8 text
+            text = self.tokenizer.decode(toks)
+            if len(text) > emitted and not text.endswith("�"):
+                choice: dict[str, Any] = {
+                    "index": index,
+                    "delta": {"role": "assistant", "content": text[emitted:]},
+                    "finish_reason": None,
+                }
+                if want_lp:
+                    choice["logprobs"] = {"content": pending_lp}
+                    pending_lp = []
+                yield {
+                    "object": "chat.completion.chunk",
+                    "model": self.model_id,
+                    "choices": [choice],
+                }
+                emitted = len(text)
+        stats.total_s = time.perf_counter() - t0
+        text = self.tokenizer.decode(toks)
+        if len(text) > emitted or pending_lp:
+            # flush text held back by the incomplete-UTF-8 guard so the chunk
+            # stream concatenates to exactly the aggregate completion
+            choice = {
+                "index": index,
+                "delta": {"role": "assistant", "content": text[emitted:]},
+                "finish_reason": None,
+            }
+            if want_lp:
+                choice["logprobs"] = {"content": pending_lp}
+            yield {
+                "object": "chat.completion.chunk",
+                "model": self.model_id,
+                "choices": [choice],
+            }
+        # the batcher's end reason covers max_tokens *and* cache-capacity
+        # terminations ("length"); a worker-drain truncation surfaces as an
+        # error when nothing was generated, or an explicit "shutdown"
+        # finish_reason on a partial completion — never as a clean "stop"
+        reason = end_info.get("finish_reason", "stop")
+        if reason == "shutdown" and not toks:
+            raise EngineError("worker draining, retry on another worker")
+        result.update(
+            text=text,
+            n_out=len(toks),
+            finish=reason if reason in ("length", "shutdown") else "stop",
+            stats=stats,
+            logprobs={"content": lp_entries} if want_lp else None,
+        )
 
     async def chat_stream(self, payload: dict) -> AsyncIterator[dict]:
         # trace context injected by the worker (serve/worker.py): popped so
@@ -263,37 +412,21 @@ class JaxChatEngine(ChatEngine):
         deadline = payload.pop("_deadline", None)
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
-        stats = GenStats(prompt_tokens=len(prompt_ids))
-        t0 = time.perf_counter()
-        toks: list[int] = []
-        emitted = 0
-        end_info: dict = {}
+        dfa, want_lp, top_n, n_choices = self._parse_ext(payload)
+        results = [dict() for _ in range(n_choices)]
         try:
-            # batched iteration: a decode burst's tokens land as ONE chunk
-            # message (the delta simply carries more text) — per-message
-            # publish overhead is a real share of throughput at 64+ streams
-            async for tok_batch in self.batcher.submit_batched(
-                prompt_ids, sp, info=end_info, trace=trace, deadline=deadline
-            ):
-                if not toks:
-                    stats.ttft_s = time.perf_counter() - t0
-                toks.extend(tok_batch)
-                stats.completion_tokens += len(tok_batch)
-                # decode incrementally; emit only completed UTF-8 text
-                text = self.tokenizer.decode(toks)
-                if len(text) > emitted and not text.endswith("�"):
-                    yield {
-                        "object": "chat.completion.chunk",
-                        "model": self.model_id,
-                        "choices": [
-                            {
-                                "index": 0,
-                                "delta": {"role": "assistant", "content": text[emitted:]},
-                                "finish_reason": None,
-                            }
-                        ],
-                    }
-                    emitted = len(text)
+            if n_choices == 1:
+                async for chunk in self._stream_one(
+                    0, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
+                    results[0],
+                ):
+                    yield chunk
+            else:
+                async for chunk in self._stream_n(
+                    prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
+                    results,
+                ):
+                    yield chunk
         except BatcherOverloaded as e:
             # honest overload envelope: the client (or the bus) retries on a
             # queue-group peer instead of waiting out an invisible queue
@@ -304,31 +437,81 @@ class JaxChatEngine(ChatEngine):
             raise EngineError(str(e)) from e
         except ValueError as e:  # e.g. prompt longer than max_seq
             raise EngineError(str(e)) from e
-        stats.total_s = time.perf_counter() - t0
-        text = self.tokenizer.decode(toks)
-        if len(text) > emitted:
-            # flush text held back by the incomplete-UTF-8 guard so the chunk
-            # stream concatenates to exactly the aggregate completion
-            yield {
-                "object": "chat.completion.chunk",
-                "model": self.model_id,
-                "choices": [
-                    {
-                        "index": 0,
-                        "delta": {"role": "assistant", "content": text[emitted:]},
-                        "finish_reason": None,
-                    }
-                ],
+        r0 = results[0]
+        out = self._completion(
+            r0["text"], len(prompt_ids),
+            sum(r["n_out"] for r in results), r0["finish"],
+            r0["stats"], logprobs=r0.get("logprobs"),
+        )
+        for i, r in enumerate(results[1:], start=1):
+            choice: dict[str, Any] = {
+                "index": i,
+                "message": {"role": "assistant", "content": r["text"]},
+                "finish_reason": r["finish"],
             }
-        # the batcher's end reason covers max_tokens *and* cache-capacity
-        # terminations ("length"); a worker-drain truncation surfaces as an
-        # error when nothing was generated, or an explicit "shutdown"
-        # finish_reason on a partial completion — never as a clean "stop"
-        reason = end_info.get("finish_reason", "stop")
-        if reason == "shutdown" and not toks:
-            raise EngineError("worker draining, retry on another worker")
-        finish = reason if reason in ("length", "shutdown") else "stop"
-        yield self._completion(text, len(prompt_ids), len(toks), finish, stats)
+            if r.get("logprobs") is not None:
+                choice["logprobs"] = r["logprobs"]
+            out["choices"].append(choice)
+        yield out
+
+    async def _stream_n(
+        self, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n, results,
+    ) -> AsyncIterator[dict]:
+        """n>1 fan-out: each choice is its own batcher request. Choice 0
+        launches alone; the rest launch after its first chunk, so choice
+        0's admit has harvested the prompt into the radix prefix cache —
+        under paged KV the siblings' identical prompts then admit as
+        zero-copy block SHARES (copy-on-write on divergence) instead of n
+        prefills and n block sets. Chunks from all choices interleave on
+        one stream, tagged by ``choices[0].index``."""
+        done = object()
+        queue: asyncio.Queue = asyncio.Queue()
+        started = asyncio.Event()
+
+        def sp_for(i: int) -> SamplingParams:
+            # distinct per-choice seeds keep choices distinct AND replayable;
+            # with no seed every choice draws its own random stream anyway
+            if i == 0 or sp.seed is None:
+                return sp
+            return dc_replace(sp, seed=sp.seed + i)
+
+        async def drive(i: int) -> None:
+            try:
+                async for chunk in self._stream_one(
+                    i, prompt_ids, sp_for(i), trace if i == 0 else None,
+                    deadline, dfa, want_lp, top_n, results[i],
+                ):
+                    await queue.put(chunk)
+                    if i == 0:
+                        started.set()
+            except Exception as e:  # noqa: BLE001 — re-raised by the merger
+                results[i]["error"] = e
+            finally:
+                if i == 0:
+                    started.set()
+                await queue.put(done)
+
+        tasks = [asyncio.ensure_future(drive(0))]
+        try:
+            await started.wait()
+            tasks += [
+                asyncio.ensure_future(drive(i)) for i in range(1, len(results))
+            ]
+            finished = 0
+            while finished < len(results):
+                item = await queue.get()
+                if item is done:
+                    finished += 1
+                    continue
+                yield item
+        finally:
+            for t in tasks:
+                t.cancel()
+        for r in results:
+            if "error" in r:
+                # a missing choice makes the whole completion wrong: fail
+                # the request honestly rather than return a short n
+                raise r["error"]
 
     def info(self) -> dict:
         return {

@@ -56,6 +56,39 @@ ROUTE_SUBJECT = "route.chat_model"  # RouterProcess's forwarding subject
 DEFAULT_HEAD_CHARS = 256
 
 
+class RouterExhausted(asyncio.TimeoutError):
+    """Retry budget exhausted without a served reply.
+
+    Subclasses :class:`asyncio.TimeoutError` so existing ``except
+    asyncio.TimeoutError`` handlers keep working, but carries structure an
+    HTTP front end needs to render an honest 503: the final *retryable*
+    envelope (if one was received), the last worker that shed the request,
+    and a retry-after hint derived from the retry policy's backoff — instead
+    of flattening all of that into a bare exception string.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        envelope: dict | None = None,
+        worker_id: str | None = None,
+        retry_after_s: float = 1.0,
+    ):
+        super().__init__(message)
+        self.envelope = envelope if isinstance(envelope, dict) else None
+        self.worker_id = worker_id
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+    def detail(self) -> str:
+        """The most specific human-readable cause available."""
+        if self.envelope is not None:
+            err = self.envelope.get("error")
+            if isinstance(err, str) and err:
+                return err
+        return str(self) or "retry budget exhausted"
+
+
 def prompt_head_hash(model: str, messages, chars: int = DEFAULT_HEAD_CHARS) -> str:
     """Hash of the prompt head, for prefix-cache locality steering.
 
@@ -267,12 +300,18 @@ class ClusterRouter:
         timeout: float = 120.0,
         headers: dict[str, str] | None = None,
         retry: RetryPolicy | None = None,
+        raise_on_exhausted: bool = False,
     ) -> Msg:
         """Steered chat request: like ``nc.request(chat_subject, ...)`` with
         a retry policy, but every attempt re-picks a worker from the live
         member table, excluded workers accumulate across hops (header AND
         pick filter), and a worker that times out is marked dead so
-        unrelated requests stop steering at it too."""
+        unrelated requests stop steering at it too.
+
+        With ``raise_on_exhausted`` a spent retry budget raises
+        :class:`RouterExhausted` (carrying the final retryable envelope and a
+        retry-after hint) instead of returning the raw retryable reply —
+        HTTP front ends use this to render a structured 503."""
         retry = retry or RetryPolicy()
         if isinstance(payload, bytes):
             body = payload
@@ -327,8 +366,13 @@ class ClusterRouter:
                     if wid not in excluded:
                         excluded.append(wid)
             else:
-                if attempt < retry.max_attempts and self._retryable(msg):
+                if self._retryable(msg):
+                    # a retryable reply on the FINAL attempt still lands in
+                    # last_msg so the exhaustion site below decides whether
+                    # to return it raw or raise RouterExhausted
                     last_exc, last_msg = None, msg
+                    if attempt >= retry.max_attempts:
+                        break
                     shed_by = NatsClient._reply_worker_id(msg) or wid
                     if shed_by and NatsClient._is_excluded_bounce(msg):
                         # one-shot exclusion consumed (see client.request)
@@ -351,12 +395,163 @@ class ClusterRouter:
             ):
                 break
         if last_msg is not None:
+            if raise_on_exhausted:
+                raise RouterExhausted(
+                    "retry budget exhausted: every worker shed this request",
+                    envelope=self._envelope_of(last_msg),
+                    worker_id=NatsClient._reply_worker_id(last_msg),
+                    retry_after_s=retry.delay_s(1),
+                )
             return last_msg
         if last_exc is not None:
+            if raise_on_exhausted:
+                raise RouterExhausted(
+                    f"retry budget exhausted: {last_exc}",
+                    retry_after_s=retry.delay_s(1),
+                ) from last_exc
             raise last_exc
-        raise asyncio.TimeoutError(
-            "deadline budget exhausted before steered chat request"
+        raise RouterExhausted(
+            "deadline budget exhausted before steered chat request",
+            retry_after_s=retry.delay_s(1),
         )
+
+    async def request_chat_stream(
+        self,
+        payload: dict | bytes,
+        timeout: float = 120.0,
+        idle_timeout: float = 30.0,
+        headers: dict[str, str] | None = None,
+        retry: RetryPolicy | None = None,
+        raise_on_exhausted: bool = False,
+    ):
+        """Steered *streaming* chat request: per-attempt worker pick like
+        :meth:`request_chat`, yielding every reply message (chunks, then the
+        ``Nats-Stream-Done`` terminal) from the winning attempt.
+
+        Retries happen only BEFORE the first chunk reaches the caller — a
+        retryable terminal or a timeout with nothing yielded re-picks a
+        worker; once a chunk is out, failure surfaces honestly (a retry
+        would replay tokens the caller already consumed). Closing this
+        generator early propagates the consumer-gone cancel down the
+        transport so the serving worker frees its batcher slot."""
+        retry = retry or RetryPolicy()
+        if isinstance(payload, bytes):
+            body = payload
+            try:
+                obj = json.loads(payload or b"{}")
+            except ValueError:
+                obj = {}
+        else:
+            obj = payload
+            body = json.dumps(payload).encode()
+        model = obj.get("model") if isinstance(obj, dict) else None
+        messages = obj.get("messages") if isinstance(obj, dict) else None
+        headers = dict(headers) if headers else {}
+        headers.setdefault(p.TRACE_HEADER, new_trace_id())
+        headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
+        deadline_hdr = headers[p.DEADLINE_HEADER]
+        excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
+        fallback = f"{self.prefix}.chat_model"
+        last_exc: BaseException | None = None
+        last_msg: Msg | None = None
+        for attempt in range(1, retry.max_attempts + 1):
+            remaining = deadline_remaining_s(deadline_hdr)
+            attempt_timeout = timeout if remaining is None else min(timeout, remaining)
+            if attempt_timeout <= 0:
+                break
+            headers[p.ATTEMPT_HEADER] = str(attempt)
+            if excluded:
+                headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
+            wid = self.pick(model=model, messages=messages, excluded=excluded)
+            if wid is not None:
+                subject = self.worker_subject(wid)
+                self.stats.routed_total += 1
+            elif self.queue_group_fallback:
+                subject = fallback
+                self.stats.fallback_total += 1
+            else:
+                raise ConnectionClosedError("no live cluster members")
+            yielded = False
+            retry_msg: Msg | None = None
+            stream = self.nc.request_stream(
+                subject, body, timeout=attempt_timeout,
+                idle_timeout=idle_timeout, headers=headers,
+            )
+            try:
+                async for msg in stream:
+                    terminal = bool(msg.headers and "Nats-Stream-Done" in msg.headers)
+                    if not yielded and terminal and self._retryable(msg):
+                        # held back even on the final attempt: the
+                        # exhaustion site decides raw-yield vs raise
+                        retry_msg = msg
+                        break
+                    yielded = True
+                    yield msg
+                    if terminal:
+                        return
+            except ConnectionClosedError as e:
+                if yielded:
+                    raise
+                last_exc, last_msg = e, None
+            except asyncio.TimeoutError as e:
+                if yielded or not retry.retry_on_timeout:
+                    raise
+                last_exc, last_msg = e, None
+                if wid is not None:
+                    self.mark_dead(wid)
+                    if wid not in excluded:
+                        excluded.append(wid)
+            else:
+                if retry_msg is None:
+                    return  # stream ended cleanly (terminal already yielded)
+                last_exc, last_msg = None, retry_msg
+                shed_by = NatsClient._reply_worker_id(retry_msg) or wid
+                if shed_by and NatsClient._is_excluded_bounce(retry_msg):
+                    if shed_by in excluded:
+                        excluded.remove(shed_by)
+                elif shed_by and shed_by not in excluded:
+                    excluded.append(shed_by)
+                if not excluded:
+                    headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
+            finally:
+                # broke out (or the caller closed us): close the transport
+                # stream so its consumer-gone cancel reaches the worker
+                await stream.aclose()
+            if attempt >= retry.max_attempts:
+                break
+            if not await NatsClient._backoff_within_budget(
+                retry.delay_s(attempt), deadline_hdr
+            ):
+                break
+        if last_msg is not None:
+            if raise_on_exhausted:
+                raise RouterExhausted(
+                    "retry budget exhausted: every worker shed this request",
+                    envelope=self._envelope_of(last_msg),
+                    worker_id=NatsClient._reply_worker_id(last_msg),
+                    retry_after_s=retry.delay_s(1),
+                )
+            yield last_msg
+            return
+        if last_exc is not None:
+            if raise_on_exhausted:
+                raise RouterExhausted(
+                    f"retry budget exhausted: {last_exc}",
+                    retry_after_s=retry.delay_s(1),
+                ) from last_exc
+            raise last_exc
+        raise RouterExhausted(
+            "deadline budget exhausted before steered chat stream",
+            retry_after_s=retry.delay_s(1),
+        )
+
+    @staticmethod
+    def _envelope_of(msg: Msg) -> dict | None:
+        try:
+            env = json.loads(msg.payload or b"null")
+        except ValueError:
+            return None
+        return env if isinstance(env, dict) else None
 
     @staticmethod
     def _retryable(msg: Msg) -> bool:

@@ -46,6 +46,7 @@ from ..transport.protocol import (
     ATTEMPT_HEADER,
     DEADLINE_HEADER,
     EXCLUDED_WORKERS_HEADER,
+    STREAM_CANCEL_SUFFIX,
     TRACE_HEADER,
     WORKER_HEADER,
     parse_worker_list,
@@ -97,6 +98,7 @@ class Worker:
         self._stop = asyncio.Event()
         self._requests_total = 0
         self._tokens_total = 0
+        self._streams_cancelled = 0  # consumer-gone aborts (<inbox>.cancel)
         self._profiling = False
         self._supervisor_task: asyncio.Task | None = None
         self._t0 = time.monotonic()
@@ -665,16 +667,69 @@ class Worker:
         final: dict | None = None
         seq = 0
         model_id = payload.get("model", "")
-        async for chunk in engine.chat_stream(payload):
-            if chunk.get("object") == "chat.completion":
-                final = chunk  # engines yield the aggregate last
-                continue
-            await self.nc.publish(
-                msg.reply,
-                json.dumps({"ok": True, "data": {"chunk": chunk}}, separators=(",", ":")).encode(),
-                headers={"X-Seq": str(seq)},
-            )
-            seq += 1
+        # consumer-gone watcher: request_stream publishes an empty message
+        # to <inbox>.cancel when its consumer abandons the stream before the
+        # terminal Nats-Stream-Done. Racing each chunk pull against that
+        # signal lets this worker close the engine stream (freeing the
+        # batcher slot) within one chunk instead of decoding to max_tokens
+        # for nobody.
+        cancel_sub = None
+        cancel_task: asyncio.Task | None = None
+        try:
+            cancel_sub = await self.nc.subscribe(msg.reply + STREAM_CANCEL_SUFFIX)
+            cancel_task = asyncio.ensure_future(cancel_sub.next_msg(timeout=None))
+        except Exception:  # noqa: BLE001 — watcher is best-effort
+            cancel_sub = None
+            cancel_task = None
+        gen = engine.chat_stream(payload)
+        cancelled = False
+        try:
+            while True:
+                step = asyncio.ensure_future(gen.__anext__())
+                if cancel_task is not None:
+                    await asyncio.wait(
+                        {step, cancel_task},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if cancel_task.done() and not step.done():
+                        step.cancel()
+                        with contextlib.suppress(
+                            BaseException
+                        ):
+                            await step
+                        cancelled = True
+                        break
+                try:
+                    chunk = await step
+                except StopAsyncIteration:
+                    break
+                if chunk.get("object") == "chat.completion":
+                    final = chunk  # engines yield the aggregate last
+                    continue
+                await self.nc.publish(
+                    msg.reply,
+                    json.dumps({"ok": True, "data": {"chunk": chunk}}, separators=(",", ":")).encode(),
+                    headers={"X-Seq": str(seq)},
+                )
+                seq += 1
+        finally:
+            if cancel_task is not None:
+                cancel_task.cancel()
+                with contextlib.suppress(BaseException):
+                    await cancel_task
+            if cancel_sub is not None:
+                with contextlib.suppress(Exception):
+                    await cancel_sub.unsubscribe()
+            if cancelled:
+                # aclose() raises GeneratorExit inside chat_stream at its
+                # yield point; submit_batched's finally cancels the batcher
+                # request, freeing the slot
+                with contextlib.suppress(BaseException):
+                    await gen.aclose()
+        if cancelled:
+            self._streams_cancelled += 1
+            trace.mark("publish")
+            return
         if final is None:
             # An engine whose stream ends without the terminal chat.completion
             # aggregate is broken: regenerating via engine.chat() here would
@@ -732,6 +787,7 @@ class Worker:
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "requests_total": self._requests_total,
             "tokens_total": self._tokens_total,
+            "streams_cancelled": self._streams_cancelled,
             "queue_group": self.config.queue_group,
             "reconnects": getattr(self.nc, "reconnects", 0),
         }
@@ -794,6 +850,8 @@ class Worker:
                   help="NATS requests handled by this worker")
         r.counter("lmstudio_tokens_total", self._tokens_total,
                   help="completion tokens generated")
+        r.counter("lmstudio_streams_cancelled_total", self._streams_cancelled,
+                  help="streaming chats aborted because the consumer vanished")
         reg = self.registry.stats()
         for key in ("models_cached", "models_loaded", "engine_requests",
                     "hbm_committed_bytes"):

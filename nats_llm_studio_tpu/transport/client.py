@@ -785,6 +785,7 @@ class NatsClient:
         await self.publish(subject, payload, reply=inbox, headers=headers)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
+        done = False
         try:
             while True:
                 remaining = deadline - loop.time()
@@ -793,8 +794,20 @@ class NatsClient:
                 msg = await sub.next_msg(timeout=min(remaining, idle_timeout))
                 yield msg
                 if msg.headers and "Nats-Stream-Done" in msg.headers:
+                    done = True
                     return
         finally:
+            if not done:
+                # consumer-gone: the caller abandoned the stream before the
+                # terminal message (HTTP client disconnected, deadline hit,
+                # generator closed). Tell the serving worker so it frees the
+                # batcher slot NOW instead of decoding to max_tokens for
+                # nobody. Best-effort: the worker's own idle timeout is the
+                # backstop if this publish is lost.
+                try:
+                    await self.publish(inbox + p.STREAM_CANCEL_SUFFIX, b"")
+                except Exception:  # noqa: BLE001 — connection may be gone
+                    pass
             await sub.unsubscribe()
 
     # -- read loop ----------------------------------------------------------

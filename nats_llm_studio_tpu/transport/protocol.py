@@ -220,6 +220,15 @@ WORKER_HEADER = "X-Worker-Id"
 EXCLUDED_WORKERS_HEADER = "X-Excluded-Workers"
 
 
+# consumer-gone signal for streaming replies: when a streaming consumer
+# abandons its inbox before the terminal Nats-Stream-Done message, the
+# client publishes an empty message to ``<inbox> + STREAM_CANCEL_SUFFIX``.
+# The serving worker subscribes to that subject for the stream's lifetime
+# and aborts generation (closing the engine stream frees the batcher slot)
+# instead of decoding to max_tokens for nobody.
+STREAM_CANCEL_SUFFIX = ".cancel"
+
+
 def parse_worker_list(value: str | None) -> list[str]:
     """Decode an ``X-Excluded-Workers`` header into worker ids (order kept,
     empties dropped); tolerant of None/garbage — a bad header must never

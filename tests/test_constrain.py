@@ -1,0 +1,198 @@
+"""JSON-schema token DFA tests (serve/constrain.py).
+
+The compile path is exercised end to end with a character-level fake
+tokenizer: random mask-guided walks through the token DFA must always
+terminate in a parseable, schema-valid JSON document, EOS must only be
+reachable at accepting states, and garbled ``response_format`` values must
+raise client-facing errors without compiling anything."""
+
+import json
+
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.serve import constrain
+from nats_llm_studio_tpu.serve.constrain import (
+    ConstraintError,
+    compile_token_dfa,
+    token_strings,
+    validate_response_format,
+)
+
+
+class CharTok:
+    """Character-level fake tokenizer using the bare ``.tokens`` fallback of
+    ``token_strings``: printable ASCII singletons plus a few multi-char
+    merges and one control EOS id at the end."""
+
+    def __init__(self):
+        chars = [chr(c) for c in range(0x20, 0x7F)]
+        merges = ['{"', '":', '", "', "true", "false", "null", "123", '"}']
+        self.tokens = chars + merges + ["<eos>"]
+        self._control_ids = frozenset({len(self.tokens) - 1})
+
+    @property
+    def eos_id(self):
+        return len(self.tokens) - 1
+
+    def decode(self, ids):
+        return "".join(self.tokens[i] for i in ids)
+
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "tag": {"enum": ["alpha", "beta"]},
+    },
+}
+
+
+def walk(dfa, eos_id, rng, max_steps=4000):
+    """Mask-guided random walk: at each state pick any allowed token. The
+    DFA contract says this can only ever stop by emitting EOS at an
+    accepting state — never by painting itself into a corner."""
+    state = dfa.start
+    toks = []
+    for _ in range(max_steps):
+        m = dfa.mask(state)
+        assert m.any(), f"dead-ended at state {state} after {len(toks)} tokens"
+        choices = np.flatnonzero(m)
+        tid = int(rng.choice(choices))
+        if tid == eos_id:
+            assert dfa.accepting(state)
+            return toks
+        nxt = dfa.advance(state, tid)
+        assert nxt is not None, (state, tid)
+        toks.append(tid)
+        state = nxt
+    raise AssertionError("walk did not terminate")
+
+
+def test_random_walks_produce_schema_valid_json():
+    jsonschema = pytest.importorskip("jsonschema")
+    tok = CharTok()
+    dfa = compile_token_dfa(SCHEMA, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    rng = np.random.default_rng(42)
+    for _ in range(20):
+        text = tok.decode(walk(dfa, tok.eos_id, rng))
+        doc = json.loads(text)  # must parse
+        jsonschema.validate(doc, SCHEMA)  # must validate
+        # declared properties are all present (declaration order)
+        assert list(doc) == ["name", "age", "tag"]
+        assert doc["tag"] in ("alpha", "beta")
+
+
+def test_greedy_style_walk_json_object_mode():
+    """``{}`` (json_object mode) compiles to a generic bounded JSON value:
+    every walk must terminate in something ``json.loads`` accepts."""
+    tok = CharTok()
+    dfa = compile_token_dfa({}, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        text = tok.decode(walk(dfa, tok.eos_id, rng))
+        json.loads(text)  # must parse
+
+
+def test_eos_only_at_accepting_states():
+    tok = CharTok()
+    dfa = compile_token_dfa(SCHEMA, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    # the empty document is not schema-valid: EOS banned at start
+    assert not dfa.accepting(dfa.start)
+    assert not dfa.mask(dfa.start)[tok.eos_id]
+    assert dfa.advance(dfa.start, tok.eos_id) is None
+    # after a full valid document (canonical tight JSON — the compiled
+    # language omits insignificant whitespace) EOS becomes reachable
+    text = '{"name":"x","age":3,"tag":"beta"}'
+    state = dfa.start
+    for ch in text:
+        state = dfa.advance(state, tok.tokens.index(ch))
+        assert state is not None, ch
+    assert dfa.accepting(state)
+    assert dfa.mask(state)[tok.eos_id]
+    assert dfa.advance(state, tok.eos_id) == state
+
+
+def test_banned_token_advance_returns_none():
+    tok = CharTok()
+    dfa = compile_token_dfa(SCHEMA, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    # a document can only open with '{' (or a merge starting with it)
+    assert dfa.advance(dfa.start, tok.tokens.index("x")) is None
+    assert dfa.mask(dfa.start)[tok.tokens.index("{")]
+
+
+def test_compile_cache_returns_identical_object():
+    tok = CharTok()
+    a = compile_token_dfa(SCHEMA, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    b = compile_token_dfa(SCHEMA, tok, len(tok.tokens), eos_ids=[tok.eos_id])
+    assert a is b
+
+
+def test_empty_language_rejected_at_compile_time():
+    class LettersOnly:
+        tokens = list("abcdefgh")
+        _control_ids = frozenset()
+
+    with pytest.raises(ConstraintError, match="empty language"):
+        compile_token_dfa(SCHEMA, LettersOnly(), len(LettersOnly.tokens))
+
+
+def test_unserializable_schema_rejected():
+    tok = CharTok()
+    with pytest.raises(ConstraintError, match="not JSON-serializable"):
+        compile_token_dfa({"x": object()}, tok, len(tok.tokens))
+
+
+def test_token_strings_llama_family():
+    class Llama:
+        model = "llama"
+        tokens = ["▁hello", "world", "<0x41>", "<0x80>", "<s>"]
+        _control_ids = frozenset({4})
+
+    out = token_strings(Llama(), 5)
+    assert out[0] == " hello"
+    assert out[1] == "world"
+    assert out[2] == "A"  # printable byte token
+    assert out[3] is None  # partial-UTF-8 byte token: banned
+    assert out[4] is None  # control token: banned
+
+
+def test_token_strings_gpt2_family():
+    class Gpt2:
+        model = "gpt2"
+        # gpt2 byte-alphabet: 'Ġ' maps to space via _u2b
+        tokens = ["Ġhi", "ok"]
+        _control_ids = frozenset()
+        _u2b = {"Ġ": 0x20}
+
+    out = token_strings(Gpt2(), 2)
+    assert out == [" hi", "ok"]
+
+
+def test_validate_response_format_cases():
+    assert validate_response_format(None) is None
+    assert validate_response_format({"type": "text"}) is None
+    assert validate_response_format({"type": "json_object"}) == {}
+    rf = {"type": "json_schema", "json_schema": {"schema": SCHEMA}}
+    assert validate_response_format(rf) == SCHEMA
+
+    for bad in (
+        "json",  # not an object
+        {"type": "jsonschema"},  # unknown type
+        {"type": "json_schema"},  # missing json_schema
+        {"type": "json_schema", "json_schema": []},  # wrong shape
+        {"type": "json_schema", "json_schema": {"schema": "x"}},  # wrong shape
+    ):
+        with pytest.raises(ValueError):
+            validate_response_format(bad)
+
+
+def test_enabled_env_gate(monkeypatch):
+    monkeypatch.delenv("CONSTRAIN", raising=False)
+    assert constrain.enabled()
+    for off in ("0", "false", "off", " 0 "):
+        monkeypatch.setenv("CONSTRAIN", off)
+        assert not constrain.enabled()
+    monkeypatch.setenv("CONSTRAIN", "1")
+    assert constrain.enabled()

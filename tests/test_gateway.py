@@ -1,0 +1,392 @@
+"""Gateway tests (gateway/server.py) against the fake echo backend:
+OpenAI payload translation edge cases, SSE round-trips, the mid-stream
+client-disconnect -> consumer-gone -> slot-freed chain, pre-bus 400s for
+garbled ``response_format``, and the structured 503 + Retry-After shape
+when the retry budget is spent."""
+
+import asyncio
+import json
+
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.gateway import Gateway
+from nats_llm_studio_tpu.gateway.server import BadRequest, translate_chat_payload
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.api import EngineError
+from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+
+from conftest import async_test
+from fakes import EchoEngine, FakeRegistry
+
+
+# -- payload translation (no bus) -------------------------------------------
+
+
+def test_translate_minimal_payload_defaults():
+    payload, stream = translate_chat_payload(
+        {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    )
+    assert payload == {"model": "m", "messages": [{"role": "user", "content": "hi"}]}
+    assert "max_tokens" not in payload  # engine default applies
+    assert stream is False
+
+
+def test_translate_drops_unknown_fields():
+    payload, stream = translate_chat_payload({
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "stream": True,
+        "frequency_penalty": 0.5,       # unsupported: dropped, not failed
+        "presence_penalty": 0.1,
+        "tool_choice": "auto",
+        "metadata": {"x": 1},
+        "temperature": 0.5,
+        "n": 2,
+    })
+    assert stream is True
+    assert "frequency_penalty" not in payload
+    assert "tool_choice" not in payload
+    assert payload["temperature"] == 0.5 and payload["n"] == 2
+
+
+def test_translate_max_completion_tokens_alias():
+    payload, _ = translate_chat_payload({
+        "model": "m",
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_completion_tokens": 17,
+    })
+    assert payload["max_tokens"] == 17
+
+
+@pytest.mark.parametrize("body,msg", [
+    ([1, 2], "JSON object"),
+    ({"messages": [{"role": "user"}]}, "'model'"),
+    ({"model": "", "messages": [{"role": "user"}]}, "'model'"),
+    ({"model": "m"}, "'messages'"),
+    ({"model": "m", "messages": []}, "'messages'"),
+    ({"model": "m", "messages": ["hi"]}, "messages[0]"),
+    ({"model": "m", "messages": [{"content": "hi"}]}, "messages[0]"),
+    ({"model": "m", "messages": [{"role": "user"}], "max_tokens": "12"},
+     "'max_tokens'"),
+    ({"model": "m", "messages": [{"role": "user"}], "n": True}, "'n'"),
+    ({"model": "m", "messages": [{"role": "user"}], "temperature": "hot"},
+     "'temperature'"),
+    ({"model": "m", "messages": [{"role": "user"}],
+      "response_format": {"type": "yaml"}}, "response_format"),
+    ({"model": "m", "messages": [{"role": "user"}],
+      "response_format": {"type": "json_schema", "json_schema": 3}},
+     "response_format"),
+])
+def test_translate_rejects_garbled_payloads(body, msg):
+    with pytest.raises(BadRequest, match=msg.replace("[", r"\[").replace("]", r"\]")):
+        translate_chat_payload(body)
+
+
+# -- HTTP harness ------------------------------------------------------------
+
+
+class CountingRegistry(FakeRegistry):
+    """Counts engine lookups: a request rejected at the gateway must leave
+    this at zero (the 400 never touched the batcher seam)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.engine_lookups = 0
+
+    async def get_engine(self, model_id):
+        self.engine_lookups += 1
+        return await super().get_engine(model_id)
+
+
+class SheddingRegistry(FakeRegistry):
+    """Every chat sheds with the retryable overload envelope."""
+
+    async def get_engine(self, model_id):
+        raise EngineError("overloaded: test shed, retry on another worker")
+
+
+class SlowEngine(EchoEngine):
+    """First chunk immediately, then parks forever; ``closed`` records the
+    GeneratorExit from the worker's consumer-gone abort."""
+
+    def __init__(self, model_id):
+        super().__init__(model_id)
+        self.closed = asyncio.Event()
+
+    async def chat_stream(self, payload):
+        try:
+            yield {
+                "object": "chat.completion.chunk",
+                "model": self.model_id,
+                "choices": [{"index": 0, "delta": {"content": "tick "}}],
+            }
+            await asyncio.sleep(3600)
+        finally:
+            self.closed.set()
+
+
+class SlowRegistry(FakeRegistry):
+    def __init__(self):
+        super().__init__()
+        self.engines = {"fake-echo-1": SlowEngine("fake-echo-1")}
+
+
+class GatewayHarness:
+    """Embedded broker + N workers + one Gateway on an ephemeral port."""
+
+    def __init__(self, registries=None, n_workers=1, chat_timeout_s=5.0):
+        self.registries = registries
+        self.n_workers = n_workers
+        self.chat_timeout_s = chat_timeout_s
+
+    async def __aenter__(self):
+        self.broker = await EmbeddedBroker().start()
+        if self.registries is None:
+            self.registries = [FakeRegistry() for _ in range(self.n_workers)]
+        self.workers = []
+        for reg in self.registries:
+            w = Worker(
+                WorkerConfig(nats_url=self.broker.url,
+                             cluster_advert_interval_s=0.05),
+                reg,
+            )
+            await w.start()
+            self.workers.append(w)
+        self.nc = await connect(self.broker.url)
+        self.gw = Gateway(
+            self.nc, port=0, chat_timeout_s=self.chat_timeout_s,
+            retry=RetryPolicy(max_attempts=2, backoff_s=0.01,
+                              retry_on_timeout=True),
+        )
+        await self.gw.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.gw.stop()
+        await self.nc.close()
+        for w in self.workers:
+            await w.drain()
+        await self.broker.stop()
+
+    async def open(self):
+        return await asyncio.open_connection("127.0.0.1", self.gw.port)
+
+    async def request(self, method, path, body=None, headers=None):
+        """One request/response on a fresh connection; returns
+        (status, headers, parsed-JSON body)."""
+        reader, writer = await self.open()
+        try:
+            await _send(writer, method, path, body, headers)
+            return await _read_response(reader)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+async def _send(writer, method, path, body=None, headers=None):
+    raw = b"" if body is None else json.dumps(body).encode()
+    head = f"{method} {path} HTTP/1.1\r\nHost: t\r\nContent-Length: {len(raw)}\r\n"
+    for k, v in (headers or {}).items():
+        head += f"{k}: {v}\r\n"
+    writer.write(head.encode() + b"\r\n" + raw)
+    await writer.drain()
+
+
+async def _read_head(reader):
+    head = await reader.readuntil(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for line in lines[1:]:
+        if ":" in line:
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+    return status, headers
+
+
+async def _read_response(reader):
+    status, headers = await _read_head(reader)
+    n = int(headers.get("content-length", "0"))
+    raw = await reader.readexactly(n) if n else await reader.read()
+    return status, headers, json.loads(raw) if raw else None
+
+
+async def _read_sse_events(reader):
+    """Read SSE frames until EOF (Connection: close delimits the body)."""
+    raw = await reader.read()
+    events = []
+    for frame in raw.decode().split("\n\n"):
+        if frame.startswith("data: "):
+            events.append(frame[len("data: "):])
+    return events
+
+
+CHAT = {"model": "fake-echo-1",
+        "messages": [{"role": "user", "content": "hi there"}]}
+
+
+# -- tests -------------------------------------------------------------------
+
+
+@async_test
+async def test_healthz_and_models():
+    async with GatewayHarness() as h:
+        status, _, body = await h.request("GET", "/healthz")
+        assert status == 200 and body["status"] == "ok"
+
+        status, _, body = await h.request("GET", "/v1/models")
+        assert status == 200
+        assert body["object"] == "list"
+        assert [m["id"] for m in body["data"]] == ["fake-echo-1"]
+
+
+@async_test
+async def test_chat_missing_max_tokens_and_unknown_fields_ok():
+    async with GatewayHarness() as h:
+        body = dict(CHAT)
+        body["frequency_penalty"] = 0.25  # unknown to this backend: ignored
+        body["tools"] = []
+        status, _, resp = await h.request("POST", "/v1/chat/completions", body)
+        assert status == 200
+        assert resp["object"] == "chat.completion"
+        assert resp["choices"][0]["message"]["content"] == "echo: hi there"
+        assert resp["id"]  # gateway backfills an id when the engine omits it
+
+
+@async_test
+async def test_chat_unknown_model_404():
+    async with GatewayHarness() as h:
+        body = {"model": "nope", "messages": [{"role": "user", "content": "x"}]}
+        status, _, resp = await h.request("POST", "/v1/chat/completions", body)
+        assert status == 404
+        assert resp["error"]["code"] == "model_not_found"
+
+
+@async_test
+async def test_garbled_response_format_400_without_touching_worker():
+    reg = CountingRegistry()
+    async with GatewayHarness(registries=[reg]) as h:
+        body = dict(CHAT)
+        body["response_format"] = {"type": "json_schema", "json_schema": "x"}
+        status, _, resp = await h.request("POST", "/v1/chat/completions", body)
+        assert status == 400
+        assert resp["error"]["type"] == "invalid_request_error"
+        assert "json_schema" in resp["error"]["message"]
+        # the 400 was produced before any bus traffic: no engine lookup
+        assert reg.engine_lookups == 0
+        assert h.workers[0]._requests_total == 0
+
+
+@async_test
+async def test_bad_json_and_wrong_method():
+    async with GatewayHarness() as h:
+        reader, writer = await h.open()
+        writer.write(b"POST /v1/chat/completions HTTP/1.1\r\nHost: t\r\n"
+                     b"Content-Length: 3\r\n\r\n{{{")
+        await writer.drain()
+        status, _, resp = await _read_response(reader)
+        writer.close()
+        assert status == 400 and "JSON" in resp["error"]["message"]
+
+        status, headers, _ = await h.request("GET", "/v1/chat/completions")
+        assert status == 405 and headers.get("allow") == "POST"
+
+        status, _, _ = await h.request("GET", "/v1/nothing")
+        assert status == 404
+
+
+@async_test
+async def test_streaming_sse_round_trip():
+    async with GatewayHarness() as h:
+        reader, writer = await h.open()
+        body = dict(CHAT, stream=True)
+        await _send(writer, "POST", "/v1/chat/completions", body)
+        status, headers = await _read_head(reader)
+        assert status == 200
+        assert headers["content-type"] == "text/event-stream"
+        events = await _read_sse_events(reader)
+        writer.close()
+
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        assert text == "echo: hi there "
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+        assert all(c["id"] == chunks[0]["id"] for c in chunks)
+        # final chunk carries the finish_reason, api.openai.com style
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        assert chunks[-1]["choices"][0]["delta"] == {}
+
+
+@async_test
+async def test_mid_stream_disconnect_cancels_the_slot():
+    """Client vanishes mid-stream: the consumer-gone cancel must travel
+    gateway -> router -> transport -> worker -> engine generator, ending in
+    a GeneratorExit that frees the slot."""
+    reg = SlowRegistry()
+    engine = reg.engines["fake-echo-1"]
+    async with GatewayHarness(registries=[reg]) as h:
+        reader, writer = await h.open()
+        await _send(writer, "POST", "/v1/chat/completions",
+                    dict(CHAT, stream=True))
+        status, _ = await _read_head(reader)
+        assert status == 200
+        first = await reader.readuntil(b"\n\n")  # one chunk arrived
+        assert b"tick" in first
+        # hang up mid-stream
+        writer.close()
+        await asyncio.wait_for(engine.closed.wait(), timeout=20.0)
+        # the worker counted the abort (and the slot was freed via aclose)
+        for _ in range(100):
+            if h.workers[0]._streams_cancelled:
+                break
+            await asyncio.sleep(0.05)
+        assert h.workers[0]._streams_cancelled == 1
+        assert h.gw.client_disconnects >= 1
+
+
+@async_test
+async def test_retry_exhaustion_is_structured_503():
+    """Every worker sheds every attempt: the gateway must answer with a
+    parseable 503 + Retry-After, not a bare exception string."""
+    async with GatewayHarness(registries=[SheddingRegistry()]) as h:
+        status, headers, resp = await h.request(
+            "POST", "/v1/chat/completions", CHAT
+        )
+        assert status == 503
+        assert int(headers["retry-after"]) >= 1
+        err = resp["error"]
+        assert err["type"] == "overloaded_error"
+        assert err["code"] == "worker_unavailable"
+        assert err["retry_after_s"] >= 1
+        # the final retryable envelope's message, not a bare traceback
+        assert "retry on another worker" in err["message"]
+
+
+@async_test
+async def test_no_worker_times_out_to_503():
+    async with GatewayHarness(n_workers=0, chat_timeout_s=0.4) as h:
+        status, headers, resp = await h.request(
+            "POST", "/v1/chat/completions", CHAT
+        )
+        assert status == 503
+        assert "retry-after" in headers
+        assert resp["error"]["type"] == "overloaded_error"
+
+
+@async_test
+async def test_streaming_exhaustion_before_first_chunk_is_http_503():
+    async with GatewayHarness(registries=[SheddingRegistry()]) as h:
+        status, headers, resp = await h.request(
+            "POST", "/v1/chat/completions", dict(CHAT, stream=True)
+        )
+        # no preamble had been sent, so the error is a proper HTTP response
+        assert status == 503
+        assert "retry-after" in headers
+        assert resp["error"]["code"] == "worker_unavailable"

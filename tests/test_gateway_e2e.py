@@ -1,0 +1,207 @@
+"""Gateway end-to-end over a real (tiny random-weight) model: JSON-schema
+constrained decoding at temperature > 0 must yield schema-valid output over
+plain HTTP, logprobs must surface as OpenAI ``logprobs.content`` entries,
+``n=2`` must return two choices, and SSE streaming must concatenate to a
+schema-valid document. A separate test drives the unmodified ``openai``
+SDK against a 2-worker cluster (skipped when the SDK is not installed)."""
+
+import asyncio
+import json
+
+import pytest
+
+from nats_llm_studio_tpu.config import WorkerConfig
+from nats_llm_studio_tpu.gateway import Gateway
+from nats_llm_studio_tpu.serve import Worker
+from nats_llm_studio_tpu.serve.registry import LocalRegistry
+from nats_llm_studio_tpu.store import ModelStore
+from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+
+from conftest import async_test
+from fakes import FakeRegistry
+from test_gateway import _read_head, _read_response, _read_sse_events, _send
+from test_serve_e2e import build_tiny_gguf
+
+MODEL = "acme/tiny-e2e"
+
+# integer/enum-only properties: the compiled language is length-bounded
+# (~45 chars worst case), so max_tokens=80 can never truncate the document
+# mid-stream — schema validity is guaranteed, not probabilistic
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "age": {"type": "integer"},
+        "tag": {"enum": ["alpha", "beta"]},
+    },
+}
+RESPONSE_FORMAT = {
+    "type": "json_schema",
+    "json_schema": {"name": "person", "schema": SCHEMA},
+}
+
+
+class RealModelGateway:
+    """Embedded broker + one real-model worker + gateway on port 0."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+
+    async def __aenter__(self):
+        src = self.tmp_path / "tiny.gguf"
+        build_tiny_gguf(src)
+        store = ModelStore(self.tmp_path / "models")
+        store.import_file(src, MODEL)
+        self.broker = await EmbeddedBroker().start()
+        self.worker = Worker(
+            WorkerConfig(nats_url=self.broker.url),
+            LocalRegistry(store, dtype="float32"),
+        )
+        await self.worker.start()
+        self.nc = await connect(self.broker.url)
+        self.gw = Gateway(self.nc, port=0, chat_timeout_s=50.0)
+        await self.gw.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.gw.stop()
+        await self.nc.close()
+        await self.worker.drain()
+        await self.broker.stop()
+
+    async def post_chat(self, body):
+        reader, writer = await asyncio.open_connection("127.0.0.1", self.gw.port)
+        try:
+            await _send(writer, "POST", "/v1/chat/completions", body)
+            return await _read_response(reader)
+        finally:
+            writer.close()
+
+
+def chat_body(**kw):
+    body = {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": "give me a person"}],
+        "max_tokens": 80,
+    }
+    body.update(kw)
+    return body
+
+
+@async_test
+async def test_constrained_logprobs_and_n_over_http(tmp_path):
+    jsonschema = pytest.importorskip("jsonschema")
+    async with RealModelGateway(tmp_path) as h:
+        # 1. json_schema constrained decode at temperature > 0: the sampled
+        # document must parse and validate — the schema guarantees it
+        status, _, resp = await h.post_chat(chat_body(
+            temperature=0.9, seed=5, response_format=RESPONSE_FORMAT,
+        ))
+        assert status == 200, resp
+        choice = resp["choices"][0]
+        doc = json.loads(choice["message"]["content"])
+        jsonschema.validate(doc, SCHEMA)
+        assert choice["finish_reason"] == "stop"
+        assert isinstance(doc["age"], int) and doc["tag"] in ("alpha", "beta")
+
+        # 2. logprobs at temperature 0: one content entry per token, the
+        # top alternative IS the greedy-chosen token
+        status, _, resp = await h.post_chat(chat_body(
+            max_tokens=5, temperature=0.0, logprobs=True, top_logprobs=3,
+        ))
+        assert status == 200, resp
+        entries = resp["choices"][0]["logprobs"]["content"]
+        assert len(entries) == 5
+        for e in entries:
+            assert isinstance(e["token"], str)
+            assert e["logprob"] <= 0.0
+            assert len(e["top_logprobs"]) == 3
+            assert e["top_logprobs"][0]["token"] == e["token"]
+            assert e["bytes"] == list(e["token"].encode())
+
+        # 3. n=2: two indexed choices, summed usage
+        status, _, resp = await h.post_chat(chat_body(
+            max_tokens=6, temperature=0.8, seed=11, n=2,
+        ))
+        assert status == 200, resp
+        assert [c["index"] for c in resp["choices"]] == [0, 1]
+        for c in resp["choices"]:
+            assert isinstance(c["message"]["content"], str)
+        assert resp["usage"]["completion_tokens"] > 6  # both choices counted
+
+
+@async_test
+async def test_constrained_streaming_sse(tmp_path):
+    jsonschema = pytest.importorskip("jsonschema")
+    async with RealModelGateway(tmp_path) as h:
+        reader, writer = await asyncio.open_connection("127.0.0.1", h.gw.port)
+        try:
+            await _send(writer, "POST", "/v1/chat/completions", chat_body(
+                temperature=0.9, seed=3, stream=True,
+                response_format=RESPONSE_FORMAT,
+            ))
+            status, headers = await _read_head(reader)
+            assert status == 200
+            assert headers["content-type"] == "text/event-stream"
+            events = await _read_sse_events(reader)
+        finally:
+            writer.close()
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        text = "".join(
+            c["choices"][0]["delta"].get("content", "") for c in chunks
+        )
+        doc = json.loads(text)
+        jsonschema.validate(doc, SCHEMA)
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+@async_test
+async def test_openai_sdk_against_two_worker_cluster():
+    """Acceptance slice: an UNMODIFIED ``openai`` Python client completes a
+    streaming chat against the gateway backed by a 2-worker cluster."""
+    openai = pytest.importorskip("openai")
+    broker = await EmbeddedBroker().start()
+    workers = []
+    for _ in range(2):
+        w = Worker(
+            WorkerConfig(nats_url=broker.url, cluster_advert_interval_s=0.05),
+            FakeRegistry(),
+        )
+        await w.start()
+        workers.append(w)
+    nc = await connect(broker.url)
+    gw = Gateway(nc, port=0,
+                 retry=RetryPolicy(max_attempts=3, retry_on_timeout=True))
+    await gw.start()
+    try:
+        client = openai.AsyncOpenAI(
+            base_url=f"http://127.0.0.1:{gw.port}/v1", api_key="unused"
+        )
+        # streaming
+        stream = await client.chat.completions.create(
+            model="fake-echo-1",
+            messages=[{"role": "user", "content": "hello world"}],
+            stream=True,
+        )
+        parts, finish = [], None
+        async for chunk in stream:
+            parts.append(chunk.choices[0].delta.content or "")
+            finish = chunk.choices[0].finish_reason or finish
+        assert "".join(parts) == "echo: hello world "
+        assert finish == "stop"
+        # non-streaming
+        resp = await client.chat.completions.create(
+            model="fake-echo-1",
+            messages=[{"role": "user", "content": "hello world"}],
+        )
+        assert resp.choices[0].message.content == "echo: hello world"
+        # model listing
+        models = await client.models.list()
+        assert [m.id for m in models.data] == ["fake-echo-1"]
+        await client.close()
+    finally:
+        await gw.stop()
+        await nc.close()
+        for w in workers:
+            await w.drain()
+        await broker.stop()

@@ -1,0 +1,326 @@
+"""Sampling invariance tests for the constrained-decoding extension.
+
+The ``mask`` parameter added to ``_pick`` / ``_log_weights`` must be a
+bitwise no-op when absent: ``_pick_ref`` / ``_log_weights_ref`` below are
+verbatim copies of the pre-extension implementations, and every
+unconstrained path is asserted bit-identical against them — greedy,
+seeded temperature, top-k, top-p, and the per-row ``sample_rows`` stream.
+With a mask, selection must stay inside the allowed set and greedy must
+equal argmax over the allowed logits; at the batcher level, masked greedy
+through ``ContinuousBatcher`` must reproduce a from-scratch reference
+loop token for token on both KV layouts."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nats_llm_studio_tpu.engine.generator import SamplingParams
+from nats_llm_studio_tpu.engine.sampling import (
+    _log_weights,
+    _pick,
+    sample_rows,
+    spec_accept_rows,
+)
+from nats_llm_studio_tpu.models.config import ModelConfig
+from nats_llm_studio_tpu.models.llama import (
+    ensure_lm_head,
+    forward,
+    init_params,
+    make_cache,
+)
+from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+from conftest import async_test
+
+CANDIDATES = 64
+_NEG_INF = jnp.float32(-jnp.inf)
+
+
+# -- verbatim pre-extension implementations (the invariance baseline) -------
+
+
+def _pick_ref(logits, gumbel, temperature, top_k, top_p) -> jax.Array:
+    """Shared sort-free selection. gumbel: [B, V] standard Gumbel noise."""
+    b, v = logits.shape
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+
+    greedy = jnp.argmax(logits, axis=-1)
+    full_pick = jnp.argmax(logits / safe_t + gumbel, axis=-1)
+
+    c = min(CANDIDATES, v)
+    cand, cand_idx = jax.lax.top_k(logits, c)
+    ranks = jnp.arange(c)[None, :]
+    k_eff = jnp.where(top_k <= 0, c, jnp.minimum(top_k, c))[:, None]
+    keep = ranks < k_eff
+    probs = jax.nn.softmax(cand / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_p[:, None]
+    g_cand = jnp.take_along_axis(gumbel, cand_idx, axis=-1)
+    masked = jnp.where(keep, cand / safe_t, _NEG_INF)
+    drawn = jnp.argmax(masked + g_cand, axis=-1)
+    cand_pick = jnp.take_along_axis(cand_idx, drawn[:, None], axis=-1)[:, 0]
+
+    restricted = ((top_k > 0) & (top_k < v)) | (top_p < 1.0)
+    pick = jnp.where(restricted, cand_pick, full_pick)
+    return jnp.where(temperature <= 0.0, greedy, pick).astype(jnp.int32)
+
+
+def _log_weights_ref(logits, temperature, top_k, top_p) -> jax.Array:
+    b, v = logits.shape
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    safe_t = jnp.maximum(temperature, 1e-6)[:, None]
+
+    c = min(CANDIDATES, v)
+    cand, cand_idx = jax.lax.top_k(logits, c)
+    ranks = jnp.arange(c)[None, :]
+    k_eff = jnp.where(top_k <= 0, c, jnp.minimum(top_k, c))[:, None]
+    keep = ranks < k_eff
+    probs = jax.nn.softmax(cand / safe_t, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep &= (cum - probs) < top_p[:, None]
+    rows = jnp.arange(b)[:, None]
+    masked = jnp.full((b, v), _NEG_INF).at[rows, cand_idx].set(
+        jnp.where(keep, cand / safe_t, _NEG_INF)
+    )
+    restricted = (((top_k > 0) & (top_k < v)) | (top_p < 1.0))[:, None]
+    return jnp.where(restricted, masked, logits / safe_t)
+
+
+SETTINGS = [
+    (0.0, 0, 1.0),   # greedy
+    (0.8, 0, 1.0),   # unrestricted temperature
+    (1.3, 5, 1.0),   # top-k
+    (0.7, 0, 0.9),   # top-p
+    (1.0, 8, 0.75),  # both
+]
+
+
+def _logits_gumbel(b=6, v=200, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    logits = jax.random.normal(k1, (b, v), jnp.float32) * 3.0
+    gumbel = jax.random.gumbel(k2, (b, v), jnp.float32)
+    return logits, gumbel
+
+
+@pytest.mark.parametrize("temp,tk,tp", SETTINGS)
+def test_pick_no_mask_bit_identical(temp, tk, tp):
+    for seed in range(3):
+        logits, gumbel = _logits_gumbel(seed=seed)
+        got = _pick(logits, gumbel, temp, tk, tp)
+        want = _pick_ref(logits, gumbel, temp, tk, tp)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("temp,tk,tp", SETTINGS)
+def test_log_weights_no_mask_bit_identical(temp, tk, tp):
+    logits, _ = _logits_gumbel(seed=11)
+    got = np.asarray(_log_weights(logits, temp, tk, tp))
+    want = np.asarray(_log_weights_ref(logits, temp, tk, tp))
+    # -inf == -inf must also compare equal — array_equal handles it
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sample_rows_no_mask_bit_identical():
+    logits, _ = _logits_gumbel(seed=5)
+    b, v = logits.shape
+    seeds = jnp.arange(100, 100 + b, dtype=jnp.int32)
+    steps = jnp.arange(b, dtype=jnp.int32) * 3
+
+    def row_gumbel(seed, step):
+        k = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.gumbel(k, (v,), jnp.float32)
+
+    gumbel = jax.vmap(row_gumbel)(seeds, steps)
+    for temp, tk, tp in SETTINGS:
+        got = sample_rows(logits, seeds, steps, temp, tk, tp)
+        want = _pick_ref(logits, gumbel, temp, tk, tp)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("temp,tk,tp", SETTINGS)
+def test_masked_pick_stays_in_allowed_set(temp, tk, tp):
+    logits, gumbel = _logits_gumbel(seed=7)
+    b, v = logits.shape
+    mask = np.zeros((b, v), dtype=bool)
+    rng = np.random.default_rng(3)
+    for i in range(b):
+        mask[i, rng.choice(v, size=17, replace=False)] = True
+    picked = np.asarray(_pick(logits, gumbel, temp, tk, tp, mask=jnp.asarray(mask)))
+    for i in range(b):
+        assert mask[i, picked[i]], (i, picked[i])
+    if temp <= 0.0:
+        # masked greedy == argmax over the allowed logits
+        want = np.where(mask, np.asarray(logits), -np.inf).argmax(axis=-1)
+        np.testing.assert_array_equal(picked, want)
+
+
+def test_masked_log_weights_bans_tokens():
+    logits, _ = _logits_gumbel(seed=9)
+    b, v = logits.shape
+    mask = np.ones((b, v), dtype=bool)
+    mask[:, ::2] = False  # ban every even token id
+    w = np.asarray(_log_weights(logits, 0.9, 0, 1.0, mask=jnp.asarray(mask)))
+    assert np.all(w[:, ::2] == -np.inf)
+    assert np.all(np.isfinite(w[:, 1::2]))
+    # all-True mask is the identity
+    w_id = np.asarray(
+        _log_weights(logits, 0.9, 0, 1.0, mask=jnp.ones((b, v), dtype=bool))
+    )
+    np.testing.assert_array_equal(w_id, np.asarray(_log_weights_ref(logits, 0.9, 0, 1.0)))
+
+
+def test_masked_spec_accept_greedy_stays_in_allowed_set():
+    b, t, v = 3, 4, 120
+    logits = jax.random.normal(jax.random.PRNGKey(21), (b, t, v), jnp.float32)
+    mask = np.zeros((b, t, v), dtype=bool)
+    allowed = np.arange(10, 40)
+    mask[:, :, allowed] = True
+    masked_greedy = np.where(mask, np.asarray(logits), -np.inf).argmax(axis=-1)
+    drafts = jnp.asarray(masked_greedy[:, : t - 1], jnp.int32)
+    toks, n_emit = spec_accept_rows(
+        logits, drafts, jnp.full((b,), t - 1, jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+        temperature=0.0, mask=jnp.asarray(mask),
+    )
+    toks, n_emit = np.asarray(toks), np.asarray(n_emit)
+    # drafts equal to the masked argmax: all accepted + masked-greedy bonus
+    np.testing.assert_array_equal(n_emit, np.full((b,), t))
+    np.testing.assert_array_equal(toks, masked_greedy)
+
+
+# -- batcher-level: masked greedy vs a from-scratch reference loop ----------
+
+
+class AllowSet:
+    """Minimal token-DFA fake: every state allows the same id set."""
+
+    def __init__(self, allowed, vocab):
+        self.allowed = sorted(allowed)
+        self.vocab = vocab
+        self.start = 0
+
+    def mask(self, state):
+        m = np.zeros(self.vocab, dtype=bool)
+        m[self.allowed] = True
+        return m
+
+    def advance(self, state, tid):
+        return state + 1 if tid in self.allowed else None
+
+    def live(self, state):
+        return True
+
+    def accepting(self, state):
+        return True
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig.tiny(n_layers=2, max_seq_len=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def masked_greedy_reference(cfg, params, prompt, n, allowed):
+    """Full re-forward each step: no KV cache, no batcher — the slowest,
+    most obviously-correct masked greedy decode."""
+    params = ensure_lm_head(params)
+    allow = np.zeros(cfg.vocab_size, dtype=bool)
+    allow[list(allowed)] = True
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        k, v = make_cache(cfg, 1, seq_len=64)
+        logits, _, _ = forward(
+            params, cfg, jnp.asarray([toks], jnp.int32), k, v,
+            jnp.zeros((1,), jnp.int32),
+        )
+        row = np.asarray(logits[0, len(toks) - 1], np.float32)
+        t = int(np.where(allow, row, -np.inf).argmax())
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+@pytest.mark.parametrize("paged", [True, False])
+@async_test
+async def test_batcher_masked_greedy_matches_reference(model, paged):
+    cfg, params = model
+    allowed = list(range(10, 30))
+    prompts = [[1, 2, 3], [9, 8, 7, 6]]
+    want = [masked_greedy_reference(cfg, params, p, 6, allowed) for p in prompts]
+
+    b = ContinuousBatcher(
+        params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64],
+        paged=paged, spec_decode_k=(0 if paged else 3),
+    )
+    try:
+        async def run(p):
+            sp = SamplingParams(temperature=0.0, max_tokens=6)
+            dfa = AllowSet(allowed, cfg.vocab_size)
+            return [t async for t in b.submit(p, sp, constrain=dfa)]
+
+        got = await asyncio.gather(*[run(p) for p in prompts])
+        assert list(got) == want
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_batcher_unconstrained_rides_along_unchanged(model):
+    """An unconstrained greedy request decoding alongside a constrained one
+    (i.e. through the masked ext program with an all-True row) must produce
+    exactly what it produces alone through the plain program."""
+    cfg, params = model
+    prompt = [5, 4, 3, 2]
+    b = ContinuousBatcher(params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64])
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+        alone = [t async for t in b.submit(prompt, sp)]
+
+        dfa = AllowSet(list(range(10, 30)), cfg.vocab_size)
+
+        async def constrained():
+            return [t async for t in b.submit([1, 2], sp, constrain=dfa)]
+
+        async def plain():
+            return [t async for t in b.submit(prompt, sp)]
+
+        rc, rn = await asyncio.gather(constrained(), plain())
+        assert rn == alone
+        assert all(t in dfa.allowed for t in rc)
+    finally:
+        b.stop()
+
+
+@async_test
+async def test_batcher_logprobs_greedy_top_entry_is_chosen_token(model):
+    cfg, params = model
+    b = ContinuousBatcher(params, cfg, max_slots=4, max_seq_len=64, buckets=[8, 64])
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+        plain = [t async for t in b.submit([3, 1, 4], sp)]
+        out = []
+        async for batch in b.submit_batched(
+            [3, 1, 4], sp, want_logprobs=True, top_logprobs=4
+        ):
+            out.extend(batch)
+        toks = [t for t, _, _, _ in out]
+        assert toks == plain  # logprobs request decodes the same tokens
+        for tok, lp, top_ids, top_lps in out:
+            assert lp <= 0.0
+            assert len(top_ids) >= 4 and len(top_lps) >= 4
+            # greedy: the chosen token is the most likely one
+            assert top_ids[0] == tok
+            assert abs(top_lps[0] - lp) < 1e-5
+            assert all(a >= b2 for a, b2 in zip(top_lps, top_lps[1:]))
+    finally:
+        b.stop()
