@@ -237,6 +237,32 @@ class WorkerConfig:
     router_prefix_head_chars: int = field(
         default_factory=lambda: int(_env("ROUTER_PREFIX_HEAD_CHARS", "256"))
     )
+    # -- disaggregated prefill/decode serving (serve/worker.py + router.py) ---
+    # phase role for this worker: "" (monolithic, the default — prefill and
+    # decode share the batcher), "prefill" (runs chunked prefill and exports
+    # finished KV blocks over lmstudio.worker.<id>.kv_export; the role-aware
+    # router never steers chats at it, though it stays in the queue group as
+    # a degradation backstop), or "decode" (pulls exported blocks from its
+    # paired prefill worker before serving, so the slot starts decoding with
+    # zero prefill work; any transfer failure falls back to local prefill)
+    worker_role: str = field(default_factory=lambda: _env("WORKER_ROLE", "").strip().lower())
+    # wall budget for one KV transfer (the decode worker's pull of the
+    # prefill worker's exported blocks); a timeout falls back to local
+    # prefill and counts into lmstudio_kv_transfer_failures_total
+    kv_transfer_timeout_s: float = field(
+        default_factory=lambda: float(_env("KV_TRANSFER_TIMEOUT_S", "10"))
+    )
+    # per-message chunk size for direct NATS block transfers (must stay
+    # under the broker max_payload; 256 KiB leaves generous header room)
+    kv_transfer_chunk_bytes: int = field(
+        default_factory=lambda: int(_env("KV_TRANSFER_CHUNK_BYTES", str(256 << 10)))
+    )
+    # blobs at or above this size ship via the JetStream Object Store
+    # (one put + an object ref over the bus) instead of chunked publishes;
+    # 0 disables the object-store path entirely (always chunked publishes)
+    kv_transfer_objstore_bytes: int = field(
+        default_factory=lambda: int(_env("KV_TRANSFER_OBJSTORE_BYTES", str(8 << 20)))
+    )
     # -- OpenAI-compatible HTTP/SSE gateway (gateway/server.py) ---------------
     # bind address for ``python -m nats_llm_studio_tpu gateway``; loopback by
     # default — exposing the front door beyond the host is an explicit choice
@@ -260,6 +286,11 @@ class WorkerConfig:
             from .utils import next_nuid
 
             self.worker_id = f"w-{next_nuid()[-8:].lower()}"
+        if self.worker_role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"WORKER_ROLE must be '', 'prefill' or 'decode', "
+                f"got {self.worker_role!r}"
+            )
 
     def configure_jax(self) -> None:
         """Apply process-wide JAX settings. Must run before the first

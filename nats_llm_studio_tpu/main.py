@@ -107,7 +107,8 @@ async def _run_serve(args: argparse.Namespace) -> None:
     )
     worker = Worker(cfg, registry)
     await worker.start()
-    log.info("worker serving %s.* on %s (models: %s)", cfg.subject_prefix, cfg.nats_url,
+    log.info("worker serving %s.* on %s (role: %s, models: %s)",
+             cfg.subject_prefix, cfg.nats_url, cfg.worker_role or "monolithic",
              cfg.models_dir)
 
     stop = asyncio.Event()

@@ -91,6 +91,46 @@ class _PoolExhausted(BatcherOverloaded):
     resetting the whole cache."""
 
 
+class _ControlOp:
+    """An owner-thread errand riding the request inbox.
+
+    Disaggregated serving needs to read (export) and write (import) the
+    paged KV pool and prefix cache, but those live as ``_run()`` locals
+    owned by the batcher thread — the inbox is the only thread-safe way
+    in. A control op is executed inline at intake (it never occupies a
+    slot and never enters the waitlist); the submitting thread blocks on
+    ``done`` and reads ``result``/``error``."""
+
+    __slots__ = ("kind", "args", "done", "result", "error", "cancelled")
+
+    def __init__(self, kind: str, args: dict):
+        self.kind = kind  # "export" | "import"
+        self.args = args
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        # set by a timed-out submitter: the owner skips the work and the
+        # (already-gone) caller never reads the result
+        self.cancelled = False
+
+    def finish(self, result=None, error: BaseException | None = None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+    def emit(self, kind: str, value) -> None:
+        """Duck-typed with _Request so the shutdown/crash drain paths
+        (_drain_all, _fail_inflight_retryable) fail a queued control op
+        instead of stranding its waiter until timeout."""
+        if kind == "err":
+            self.finish(error=value)
+        else:
+            self.finish(error=BatcherStopped(
+                f"batcher stopped ({value}) before kv {self.kind} ran; "
+                f"retry on another worker"
+            ))
+
+
 @dataclass
 class _Request:
     prompt_ids: list[int]
@@ -1734,6 +1774,54 @@ class ContinuousBatcher:
             if not done:
                 self.cancel(req)
 
+    # -- disaggregated prefill/decode (serve/kv_transfer.py) -----------------
+
+    def export_prefix_blocks(self, prompt_ids: list[int],
+                             timeout: float = 30.0) -> dict | None:
+        """Gather the prompt's cached full-chunk KV blocks to HOST memory.
+
+        Returns the ``serve.kv_transfer`` export dict (token_ids /
+        chunk_tokens / per-chunk k, v, logits leaves as numpy arrays or
+        KVQ (codes, scales) pairs), or None when the prefix cache holds
+        nothing useful for this prompt (short prompt, cache miss, pool
+        reset). Thread-safe: marshals onto the owner thread through the
+        inbox; blocking — call via ``asyncio.to_thread`` from a loop."""
+        return self._control(_ControlOp(
+            "export", {"prompt_ids": [int(t) for t in prompt_ids]}
+        ), timeout)
+
+    def import_prefix_blocks(self, export: dict,
+                             timeout: float = 30.0) -> dict:
+        """Write a transferred prefill export into freshly allocated pool
+        blocks and seed the radix prefix cache, so the matching request's
+        admit becomes a prefix hit (full hit ⇒ zero local prefill work).
+        Returns ``{"tokens": covered, "blocks": allocated}``. Raises
+        ``BatcherOverloaded`` (cause ``kv_pool``) when the pool cannot
+        hold the import — the decode-pool-exhaustion failure mode; the
+        caller falls back to local prefill. Thread-safe and blocking,
+        like :meth:`export_prefix_blocks`."""
+        return self._control(_ControlOp("import", {"export": export}), timeout)
+
+    def _control(self, op: _ControlOp, timeout: float):
+        if not self._started:
+            self.start()
+        with self._submit_lock:
+            if self._stopping:
+                raise BatcherStopped(
+                    "batcher is stopped; retry on another worker"
+                )
+            self._inbox.put(op)
+        if not op.done.wait(timeout):
+            # the owner may still run it later; it checks this flag and
+            # skips — nobody is left to read the result
+            op.cancelled = True
+            raise TimeoutError(
+                f"kv {op.kind} control op timed out after {timeout:.1f}s"
+            )
+        if op.error is not None:
+            raise op.error
+        return op.result
+
     # -- device loop (owner thread) ------------------------------------------
 
     def _bucket(self, n: int) -> int:
@@ -2482,6 +2570,143 @@ class ContinuousBatcher:
                 ))
             pc.insert(list(prompt_ids[: n_full * C]), blocks, chunk_logits)
 
+        def _host_kv(x):
+            """Device block view -> host leaves (KVQ ships as a pair)."""
+            if is_quantized(x):
+                return (np.asarray(x.q), np.asarray(x.s))
+            return np.asarray(x)
+
+        def _dev_kv(leaf):
+            """Host leaves -> the row shape kv_pool_write_row wants."""
+            if isinstance(leaf, tuple):
+                q, s = leaf
+                return KVQ(q=jnp.asarray(np.asarray(q)),
+                           s=jnp.asarray(np.asarray(s)))
+            return jnp.asarray(np.asarray(leaf))
+
+        def control_export(args) -> dict | None:
+            """Owner-thread half of disaggregated PREFILL: gather the
+            prompt's cached full-chunk KV blocks (plus chunk-end logits)
+            to host arrays for shipment to a decode peer. None means
+            nothing useful is cached — the decode side falls back to
+            local prefill, which is always correct."""
+            if not paged or pc is None:
+                return None
+            prompt_ids = args["prompt_ids"]
+            C = self.prefill_chunk
+            if len(prompt_ids) < C:
+                return None
+            hit = pc.match(prompt_ids)
+            if hit is None:
+                return None
+            try:
+                if any(
+                    p2 is None or p2[0] != pool.epoch for p2 in hit.payloads
+                ):
+                    # survived a pool reset: the ids reference recycled blocks
+                    return None
+                chunks = []
+                for j, (_, ids) in enumerate(hit.payloads):
+                    bids = jnp.asarray(ids, jnp.int32)
+                    lg = hit.nodes[j].logits
+                    chunks.append({
+                        "k": _host_kv(kv_pool_read_blocks(K, bids)),
+                        "v": _host_kv(kv_pool_read_blocks(V, bids)),
+                        "logits": None if lg is None
+                        else np.asarray(lg, np.float32).reshape(-1),
+                    })
+                return {
+                    "token_ids": [int(t) for t in prompt_ids[: hit.tokens]],
+                    "chunk_tokens": C,
+                    "chunks": chunks,
+                }
+            finally:
+                pc.release(hit)
+
+        def control_import(args) -> dict:
+            """Owner-thread half of disaggregated DECODE: write the
+            transferred chunks into freshly allocated pool blocks and
+            seed the prefix cache, so the request that follows admits as
+            a prefix hit. The import's own allocation refs are dropped
+            once the cache's acquire_fn holds the surviving ones; a
+            _PoolExhausted (decode-pool exhaustion) frees everything
+            allocated so far and propagates cleanly."""
+            nonlocal K, V
+            if not paged or pc is None:
+                raise ValueError(
+                    "kv import requires paged KV and a prefix cache"
+                )
+            export = args["export"]
+            C = self.prefill_chunk
+            if int(export["chunk_tokens"]) != C:
+                raise ValueError(
+                    f"prefill-chunk mismatch: export C="
+                    f"{export['chunk_tokens']}, local C={C}"
+                )
+            token_ids = [int(t) for t in export["token_ids"]]
+            n_full = min(len(token_ids) // C, len(export["chunks"]))
+            if n_full <= 0:
+                return {"tokens": 0, "blocks": 0}
+            nbc = C // T
+            alloc: list[int] = []
+            payloads: list = []
+            logits_list: list = []
+            try:
+                for j in range(n_full):
+                    ch = export["chunks"][j]
+                    ids = alloc_blocks(nbc)
+                    alloc.extend(ids)
+                    bids = jnp.asarray(ids, jnp.int32)
+                    K = kv_pool_write_row(K, _dev_kv(ch["k"]), bids)
+                    V = kv_pool_write_row(V, _dev_kv(ch["v"]), bids)
+                    payloads.append((pool.epoch, list(ids)))
+                    lg = ch.get("logits")
+                    logits_list.append(
+                        None if lg is None
+                        else jnp.asarray(
+                            np.asarray(lg), jnp.float32
+                        ).reshape(1, 1, -1)
+                    )
+            except BaseException:
+                if alloc:
+                    pool.decref(alloc)
+                raise
+            if self.mesh is not None:
+                # the eager .at[].set updates may lose the pool sharding;
+                # re-pin so later donated dispatches see the layout they
+                # were compiled for
+                from ..parallel.sharding import pool_spec, shard_cache
+
+                K, V = shard_cache(
+                    K, V, self.mesh, cfg=cfg,
+                    spec=pool_spec(self.mesh, cfg),
+                )
+            pc.insert(token_ids[: n_full * C], payloads, logits_list)
+            # the cache's acquire_fn holds the surviving refs (a chunk
+            # whose node already existed stays owned by that node; these
+            # fresh blocks free right here)
+            pool.decref(alloc)
+            return {"tokens": n_full * C, "blocks": len(alloc)}
+
+        def run_control(op: _ControlOp) -> None:
+            """Execute one inbox control op inline; failures return to the
+            waiting caller and never crash the pump."""
+            self.heartbeat = time.monotonic()
+            if op.cancelled:  # submitter timed out; nobody reads the result
+                op.finish(error=TimeoutError("control op abandoned"))
+                return
+            try:
+                if op.kind == "export":
+                    op.finish(result=control_export(op.args))
+                elif op.kind == "import":
+                    op.finish(result=control_import(op.args))
+                else:
+                    op.finish(error=ValueError(
+                        f"unknown control op {op.kind!r}"
+                    ))
+            except Exception as e:  # noqa: BLE001 — caller's error, not ours
+                op.finish(error=e)
+
         def admit_paged(req: _Request, slot: int, n: int, seed: int,
                         samp) -> jax.Array:
             """Paged admit: allocate the slot's block table up front (raising
@@ -3169,6 +3394,9 @@ class ContinuousBatcher:
                 if item is None:
                     self._drain_all("shutdown", waitlist)
                     return
+                if isinstance(item, _ControlOp):
+                    run_control(item)
+                    continue
                 if item.cancelled:
                     self.stats.record_cancel("inbox")
                     continue
@@ -3194,6 +3422,9 @@ class ContinuousBatcher:
                         if nxt is None:
                             self._drain_all("shutdown", waitlist)
                             return
+                        if isinstance(nxt, _ControlOp):
+                            run_control(nxt)
+                            continue
                         if nxt.cancelled:
                             self.stats.record_cancel("inbox")
                             continue
@@ -3346,6 +3577,9 @@ class ContinuousBatcher:
                                 # outer intake to see after this admit
                                 self._inbox.put(None)
                                 return False
+                            if isinstance(nxt, _ControlOp):
+                                run_control(nxt)
+                                continue
                             if nxt.cancelled:
                                 self.stats.record_cancel("inbox")
                                 continue
@@ -3393,6 +3627,9 @@ class ContinuousBatcher:
                                 if nxt is None:
                                     self._inbox.put(None)
                                     break
+                                if isinstance(nxt, _ControlOp):
+                                    run_control(nxt)
+                                    continue
                                 if nxt.cancelled:
                                     self.stats.record_cancel("inbox")
                                     continue

@@ -513,6 +513,55 @@ class JaxChatEngine(ChatEngine):
                 # the request honestly rather than return a short n
                 raise r["error"]
 
+    # -- disaggregated prefill/decode (serve/kv_transfer.py) -----------------
+
+    async def export_prefix(self, payload: dict) -> dict | None:
+        """Prefill-role half of disaggregated serving: ensure this chat
+        payload's prompt KV is prefilled and harvested into the local
+        radix prefix cache, then gather the cached blocks to host memory
+        for shipment to a decode peer. Returns the ``serve.kv_transfer``
+        export dict, or None when there is nothing chunk-aligned worth
+        shipping (short prompt, harvest paused under brownout, cache
+        pressure) — the decode side then serves with local prefill,
+        which is always correct."""
+        payload = dict(payload)
+        trace = payload.pop("_trace", None)
+        deadline = payload.pop("_deadline", None)
+        prompt_ids = self._encode_prompt(payload)
+        C = self.batcher.prefill_chunk
+        if len(prompt_ids) < C:
+            return None
+        n_cover = (len(prompt_ids) // C) * C
+        export = await asyncio.to_thread(
+            self.batcher.export_prefix_blocks, prompt_ids
+        )
+        if export is None or len(export["token_ids"]) < n_cover:
+            # cold cache: run the chunked prefill HERE (that is this
+            # worker's whole job) — admit harvests the blocks into the
+            # prefix cache, the single greedy token is discarded — then
+            # re-gather. The decode peer samples the real first token
+            # from the shipped chunk-end logits with the request's own
+            # sampling params, so the throwaway settings don't leak.
+            sp = SamplingParams(temperature=0.0, max_tokens=1)
+            async for _ in self.batcher.submit(
+                prompt_ids, sp, trace=trace, deadline=deadline
+            ):
+                pass
+            export = await asyncio.to_thread(
+                self.batcher.export_prefix_blocks, prompt_ids
+            )
+        return export
+
+    async def import_prefix(self, export: dict) -> dict:
+        """Decode-role half: drop transferred blocks into the local block
+        pool and seed the prefix cache, so the chat that triggered the
+        transfer admits as a prefix hit (full hit ⇒ zero prefill work).
+        Raises on pool exhaustion or layout mismatch; the worker counts
+        the failure and falls back to local prefill."""
+        return await asyncio.to_thread(
+            self.batcher.import_prefix_blocks, export
+        )
+
     def info(self) -> dict:
         return {
             "id": self.model_id,
@@ -557,6 +606,7 @@ class LocalRegistry(Registry):
         kv_paged: bool | None = None,
         kv_block_tokens: int | None = None,
         kv_pool_blocks: int | None = None,
+        prefill_chunk: int | None = None,
         obs_recorder: bool | None = None,
         obs_recorder_interval_ms: float | None = None,
         obs_dump_dir: str | None = None,
@@ -606,6 +656,11 @@ class LocalRegistry(Registry):
             if kv_pool_blocks is not None
             else _kv_pool_blocks_env()
         )
+        # prefill chunk size handed to every batcher (None = the batcher
+        # default, clamped to max_seq_len). Tiny serving setups — tests and
+        # the disagg bench — need small chunks so a short prompt still
+        # covers whole chunks for KV export (serve/kv_transfer.py)
+        self.prefill_chunk = prefill_chunk
         # adaptive brownout (serve/brownout.py) handed to every batcher;
         # None reads BROWNOUT from the env (default on), the BROWNOUT_*
         # threshold knobs tune the hysteresis. The HBM-headroom signal is
@@ -1075,6 +1130,8 @@ class LocalRegistry(Registry):
             kv_block_tokens=self.kv_block_tokens,
             kv_pool_blocks=self.kv_pool_blocks,
             recorder=recorder,
+            **({"prefill_chunk": self.prefill_chunk}
+               if self.prefill_chunk else {}),
         )
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time

@@ -138,6 +138,7 @@ class WorkerAdvert:
     """One worker's most recent cluster advert, as the router sees it."""
 
     worker_id: str
+    role: str = ""  # "" monolithic / "prefill" / "decode" (ISSUE 13)
     queue_depth: int = 0
     brownout: int = 0  # 0 NORMAL / 1 BROWNOUT / 2 SHED_ONLY
     hbm_headroom: float = 1.0
@@ -152,8 +153,10 @@ class WorkerAdvert:
         wid = d.get("worker_id")
         if not isinstance(wid, str) or not wid:
             return None
+        role = d.get("role")
         return cls(
             worker_id=wid,
+            role=role if isinstance(role, str) else "",
             queue_depth=int(d.get("queue_depth") or 0),
             brownout=int(d.get("brownout") or 0),
             hbm_headroom=float(d.get("hbm_headroom", 1.0)),
@@ -171,6 +174,7 @@ class RouterStats:
     fallback_total: int = 0  # no live member: plain queue-group subject
     locality_total: int = 0  # picks won by a prefix-head match
     dead_marked_total: int = 0  # members dropped after a timeout/sever
+    two_hop_total: int = 0  # picks that paired a prefill-role worker
 
     def as_dict(self) -> dict:
         return {
@@ -178,6 +182,7 @@ class RouterStats:
             "fallback_total": self.fallback_total,
             "locality_total": self.locality_total,
             "dead_marked_total": self.dead_marked_total,
+            "two_hop_total": self.two_hop_total,
         }
 
 
@@ -266,18 +271,45 @@ class ClusterRouter:
         excluded: tuple[str, ...] | list[str] = (),
     ) -> str | None:
         """Best live worker id, or None (caller falls back to the queue
-        group). Ranking: prefix-head locality first (a sticky worker replays
-        the cached prefill), then brownout level, then model-loaded, then
-        queue depth. Draining and excluded workers never win."""
+        group). Role-aware: see :meth:`pick_pair` (this is its first half)."""
+        return self.pick_pair(model=model, messages=messages, excluded=excluded)[0]
+
+    def pick_pair(
+        self,
+        model: str | None = None,
+        messages=None,
+        excluded: tuple[str, ...] | list[str] = (),
+    ) -> tuple[str | None, str | None]:
+        """Role-aware pick: ``(serving_worker_id, prefill_worker_id)``.
+
+        Serving candidates exclude prefill-role workers whenever any
+        non-prefill member is live — a prefill worker's pool churns through
+        transient prefill blocks and must not also hold long decodes. With
+        no live members at all the caller falls back to the queue group;
+        with ONLY prefill-role members live they serve (degraded but up).
+        Ranking within candidates is unchanged: prefix-head locality first
+        (a sticky worker replays the cached prefill), then brownout level,
+        then model-loaded, then queue depth. Draining and excluded workers
+        never win.
+
+        The second element is the best live prefill-role worker, returned
+        only when the serving pick is decode-role — the caller stamps it in
+        ``X-KV-Prefill-Worker`` so the decode worker pulls the prompt's KV
+        blocks from it (the disaggregated two-hop). Monolithic picks never
+        pair: they prefill locally anyway."""
         head = None
         if model and messages and self.prefix_head_chars > 0:
             head = prompt_head_hash(model, messages, self.prefix_head_chars)
+        candidates = [
+            m for m in self.members()
+            if not m.draining and m.worker_id not in excluded
+        ]
+        serving = [m for m in candidates if m.role != "prefill"] or candidates
         best: tuple | None = None
         best_id: str | None = None
         best_local = False
-        for m in self.members():
-            if m.draining or m.worker_id in excluded:
-                continue
+        best_role = ""
+        for m in serving:
             local = head is not None and head in m.heads and m.brownout < 2
             key = (
                 0 if local else 1,
@@ -287,10 +319,26 @@ class ClusterRouter:
                 m.worker_id,  # total order: deterministic under ties
             )
             if best is None or key < best:
-                best, best_id, best_local = key, m.worker_id, local
+                best, best_id, best_local, best_role = key, m.worker_id, local, m.role
         if best_id is not None and best_local:
             self.stats.locality_total += 1
-        return best_id
+        prefill_id: str | None = None
+        if best_id is not None and best_role == "decode":
+            pbest: tuple | None = None
+            for m in candidates:
+                if m.role != "prefill" or m.brownout >= 2:
+                    continue
+                pkey = (
+                    m.brownout,
+                    0 if (model and model in m.models) else 1,
+                    m.queue_depth,
+                    m.worker_id,
+                )
+                if pbest is None or pkey < pbest:
+                    pbest, prefill_id = pkey, m.worker_id
+        if prefill_id is not None:
+            self.stats.two_hop_total += 1
+        return best_id, prefill_id
 
     # -- steered request-reply ----------------------------------------------
 
@@ -340,7 +388,16 @@ class ClusterRouter:
             headers[p.ATTEMPT_HEADER] = str(attempt)
             if excluded:
                 headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
-            wid = self.pick(model=model, messages=messages, excluded=excluded)
+            wid, prefill_wid = self.pick_pair(
+                model=model, messages=messages, excluded=excluded
+            )
+            if prefill_wid is not None and prefill_wid != wid:
+                # disaggregated two-hop: name the prefill-role worker the
+                # decode target should pull KV blocks from. Re-stamped (or
+                # dropped) per attempt — the prefill peer may die mid-retry.
+                headers[p.KV_PREFILL_HEADER] = prefill_wid
+            else:
+                headers.pop(p.KV_PREFILL_HEADER, None)
             if wid is not None:
                 subject = self.worker_subject(wid)
                 self.stats.routed_total += 1
@@ -462,7 +519,13 @@ class ClusterRouter:
             headers[p.ATTEMPT_HEADER] = str(attempt)
             if excluded:
                 headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
-            wid = self.pick(model=model, messages=messages, excluded=excluded)
+            wid, prefill_wid = self.pick_pair(
+                model=model, messages=messages, excluded=excluded
+            )
+            if prefill_wid is not None and prefill_wid != wid:
+                headers[p.KV_PREFILL_HEADER] = prefill_wid
+            else:
+                headers.pop(p.KV_PREFILL_HEADER, None)
             if wid is not None:
                 subject = self.worker_subject(wid)
                 self.stats.routed_total += 1

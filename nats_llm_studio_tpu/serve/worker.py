@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import hashlib
 import json
 import logging
 import os
@@ -46,12 +47,14 @@ from ..transport.protocol import (
     ATTEMPT_HEADER,
     DEADLINE_HEADER,
     EXCLUDED_WORKERS_HEADER,
+    KV_PREFILL_HEADER,
     STREAM_CANCEL_SUFFIX,
     TRACE_HEADER,
     WORKER_HEADER,
     parse_worker_list,
 )
 from .api import EngineError, ModelNotFound, Registry
+from .kv_transfer import KVTransferFormatError, decode_kv_blob, encode_kv_blob
 from .router import ADVERT_SUBJECT, RecentHeads, prompt_head_hash
 
 log = logging.getLogger(__name__)
@@ -110,6 +113,12 @@ class Worker:
         self._recent_heads = RecentHeads()
         self._excluded_bounce_total = 0  # X-Excluded-Workers self-matches
         self._drain_bounce_total = 0  # requests bounced while draining
+        # -- disaggregated prefill/decode (ISSUE 13) -------------------------
+        # bytes/ms by direction: "export" is KV shipped to decode peers (we
+        # are the prefill side), "import" is KV pulled from a prefill peer
+        self._kv_transfer_bytes = {"export": 0, "import": 0}
+        self._kv_transfer_ms = {"export": 0.0, "import": 0.0}
+        self._kv_transfer_failures = 0  # pulls that fell back to local prefill
         # chat requests slower than this end-to-end land in the event ring
         # for post-hoc diagnosis (0 disables)
         self._slow_request_ms = float(
@@ -173,6 +182,10 @@ class Worker:
             ("chat_model", self.on_chat_model),
             ("health", self.on_health),
             ("metrics.prom", self.on_metrics_prom),
+            # every worker serves kv_export (not just prefill-role ones):
+            # an engine that cannot export replies no_export gracefully, so
+            # a stale role map degrades to local prefill instead of timeout
+            ("kv_export", self.on_kv_export),
         ):
             await self.nc.subscribe(f"{wid_prefix}.{op}", cb=self._guarded(handler))
         # drain control: broadcast subject, each worker matches on payload
@@ -230,6 +243,7 @@ class Worker:
             headroom = 1.0
         return {
             "worker_id": self.worker_id,
+            "role": getattr(self.config, "worker_role", ""),
             "queue_depth": depth,
             "brownout": brownout,
             "hbm_headroom": round(headroom, 4),
@@ -588,6 +602,15 @@ class Worker:
         try:
             async with _timeout(self.config.chat_timeout_s):
                 engine = await self.registry.get_engine(model_id)
+                prefill_peer = (hdrs.get(KV_PREFILL_HEADER) or "").strip()
+                if prefill_peer and prefill_peer != self.worker_id:
+                    # disaggregated two-hop: the router already ran (or is
+                    # running) this prompt's prefill on the named peer; pull
+                    # its KV blocks into our pool before serving so decode
+                    # starts from a full prefix-cache hit. Never fatal — any
+                    # failure inside counts itself and we prefill locally.
+                    await self._kv_prefetch(engine, model_id, payload,
+                                            prefill_peer, trace)
                 if streaming:
                     await self._chat_streaming(msg, engine, payload, trace)
                 else:
@@ -750,6 +773,225 @@ class Worker:
                      WORKER_HEADER: self.worker_id},
         )
 
+    # -- disaggregated prefill/decode (ISSUE 13 tentpole) --------------------
+
+    async def on_kv_export(self, msg: Msg) -> None:
+        """kv_export — directed-only subject ``{prefix}.worker.<id>.kv_export``:
+        a decode-role peer sends the chat body ``{model, messages}``; this
+        (prefill-role) worker runs/looks-up the prompt's chunked prefill,
+        gathers the finished KV blocks to host memory, and streams the
+        serialized blob back as raw binary chunk messages followed by a
+        terminal ``Nats-Stream-Done`` JSON envelope ``{sha256, bytes,
+        chunks}``. Over ``kv_transfer_objstore_bytes`` the blob ships via
+        the JetStream Object Store instead and the terminal envelope carries
+        ``{bucket, object, sha256, bytes}``.
+
+        An engine that cannot export (fake/test engine, prompt shorter than
+        one prefill chunk, dense-only batcher) answers ``{no_export: true}``
+        — a graceful skip the peer treats as "prefill locally", never an
+        error."""
+        self._requests_total += 1
+        if not msg.reply:
+            return  # nowhere to ship the blob
+        t0 = time.monotonic()
+        try:
+            payload = json.loads(msg.payload or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._error_terminal(
+                msg, f"invalid JSON in KvExport: {e}", None, True
+            )
+            return
+        model_id = (payload.get("model") or "").strip()
+        if not model_id:
+            await self._error_terminal(
+                msg, "'model' is required in KvExport", None, True
+            )
+            return
+        try:
+            async with _timeout(self.config.kv_transfer_timeout_s):
+                engine = await self.registry.get_engine(model_id)
+                export_fn = getattr(engine, "export_prefix", None)
+                export = (
+                    await export_fn(dict(payload)) if export_fn is not None else None
+                )
+        except asyncio.TimeoutError:
+            await self._error_terminal(
+                msg, "error in kv export: deadline exceeded",
+                {"model": model_id}, True,
+            )
+            return
+        except (ModelNotFound, EngineError, ValueError, RuntimeError) as e:
+            # ValueError/RuntimeError: the export's internal prefill can hit
+            # the same admission guards as a chat (prompt >= max_seq, pool
+            # exhaustion). A terminal error lets the puller fall back to
+            # local prefill immediately instead of idling out its pull.
+            await self._error_terminal(
+                msg, f"error in kv export: {e}", {"model": model_id}, True
+            )
+            return
+        if export is None or not export.get("chunks"):
+            await self._respond_json(
+                msg, envelope_ok({"no_export": True}),
+                headers={"Nats-Stream-Done": "1"},
+            )
+            return
+        try:
+            blob = encode_kv_blob(export)
+        except KVTransferFormatError as e:
+            await self._error_terminal(
+                msg, f"error in kv export: {e}", {"model": model_id}, True
+            )
+            return
+        digest = hashlib.sha256(blob).hexdigest()
+        meta = {"sha256": digest, "bytes": len(blob),
+                "tokens": len(export["token_ids"])}
+        sent = await self._ship_blob(msg, blob, meta)
+        if sent:
+            self._kv_transfer_bytes["export"] += len(blob)
+            self._kv_transfer_ms["export"] += (time.monotonic() - t0) * 1000.0
+            EVENTS.emit("kv_export", model=model_id, bytes=len(blob),
+                        tokens=meta["tokens"])
+
+    async def _ship_blob(self, msg: Msg, blob: bytes, meta: dict) -> bool:
+        """Ship an encoded KV blob to ``msg.reply``: Object Store when the
+        blob crosses the configured threshold (and JetStream answers),
+        otherwise chunked inline publishes. Returns False only when even the
+        inline path failed (connection gone)."""
+        assert self.nc is not None
+        cfg = self.config
+        objstore_min = int(getattr(cfg, "kv_transfer_objstore_bytes", 0) or 0)
+        if objstore_min > 0 and len(blob) >= objstore_min:
+            from ..transport.jetstream import ObjectStore
+
+            bucket = "kv-transfer"
+            obj = f"{self.worker_id}-{meta['sha256'][:16]}"
+            try:
+                store = ObjectStore(self.nc, timeout=cfg.kv_transfer_timeout_s)
+                await store.ensure_bucket(bucket)
+                await store.put(bucket, obj, blob)
+                await self._respond_json(
+                    msg,
+                    envelope_ok({**meta, "bucket": bucket, "object": obj}),
+                    headers={"Nats-Stream-Done": "1"},
+                )
+                return True
+            except Exception as e:  # noqa: BLE001 — objstore is an optimization
+                # no JetStream on this broker (or a mid-put hiccup): the
+                # inline chunk path below is the degradation, not a failure
+                log.warning("kv export object-store path failed (%s); "
+                            "falling back to inline chunks", e)
+        chunk_bytes = max(1, int(getattr(cfg, "kv_transfer_chunk_bytes", 256 << 10)))
+        limit = (getattr(self.nc, "server_info", None) or {}).get("max_payload")
+        if limit:
+            # leave headroom for the header block within the broker frame
+            chunk_bytes = min(chunk_bytes, max(1, int(limit) - 1024))
+        try:
+            seq = 0
+            for off in range(0, len(blob), chunk_bytes):
+                await self.nc.publish(
+                    msg.reply, blob[off : off + chunk_bytes],
+                    headers={"X-KV-Seq": str(seq)},
+                )
+                seq += 1
+            await self._respond_json(
+                msg, envelope_ok({**meta, "chunks": seq}),
+                headers={"Nats-Stream-Done": "1"},
+            )
+            return True
+        except (ConnectionError, ValueError):
+            log.warning("kv export to %s failed mid-ship", msg.reply)
+            return False
+
+    async def _kv_prefetch(
+        self, engine, model_id: str, payload: dict, peer: str, trace: Trace
+    ) -> None:
+        """Decode-side pull: fetch the prompt's exported KV blocks from the
+        prefill peer's directed ``kv_export`` subject, verify the SHA-256,
+        and import them into the local engine's block pool + prefix cache so
+        the chat below decodes from a full prefix hit (zero local prefill).
+
+        EVERY failure mode — peer gone, transfer timeout, digest mismatch,
+        malformed blob, decode-pool exhaustion on import — lands in
+        ``lmstudio_kv_transfer_failures_total`` and returns normally: the
+        caller serves with local prefill, bit-identical, just slower."""
+        import_fn = getattr(engine, "import_prefix", None)
+        if import_fn is None:
+            return  # engine can't import (fake/test engine): local prefill
+        assert self.nc is not None
+        cfg = self.config
+        t0 = time.monotonic()
+        trace.mark("kv_pull")
+        req = {"model": model_id, "messages": payload.get("messages")}
+        subject = f"{cfg.subject_prefix}.worker.{peer}.kv_export"
+        try:
+            parts: list[bytes] = []
+            meta: dict | None = None
+            stream = self.nc.request_stream(
+                subject,
+                json.dumps(req, separators=(",", ":")).encode(),
+                timeout=cfg.kv_transfer_timeout_s,
+                idle_timeout=cfg.kv_transfer_timeout_s,
+                headers={TRACE_HEADER: trace.trace_id},
+            )
+            async for m in stream:
+                if m.headers and "Nats-Stream-Done" in m.headers:
+                    env = json.loads(m.payload)
+                    if not env.get("ok"):
+                        raise ConnectionError(
+                            f"kv export failed on {peer}: {env.get('error')}"
+                        )
+                    meta = env.get("data") or {}
+                else:
+                    parts.append(m.payload)
+            if meta is None:
+                raise ConnectionError(f"kv export stream from {peer} ended early")
+            if meta.get("no_export"):
+                # graceful skip (peer can't export this prompt) — NOT a
+                # transfer failure; just prefill locally
+                trace.mark("kv_import")
+                return
+            if meta.get("object"):
+                from ..transport.jetstream import ObjectStore
+
+                store = ObjectStore(self.nc, timeout=cfg.kv_transfer_timeout_s)
+                blob = await store.get(meta["bucket"], meta["object"])
+                # best-effort cleanup: the blob is single-use
+                with contextlib.suppress(Exception):
+                    await store.delete(meta["bucket"], meta["object"])
+            else:
+                blob = b"".join(parts)
+            if len(blob) != int(meta.get("bytes", -1)) or (
+                hashlib.sha256(blob).hexdigest() != meta.get("sha256")
+            ):
+                raise KVTransferFormatError(
+                    f"kv blob from {peer} failed integrity check "
+                    f"({len(blob)} bytes)"
+                )
+            export = decode_kv_blob(blob)
+            trace.mark("kv_import")
+            imported = await import_fn(export)
+            self._kv_transfer_bytes["import"] += len(blob)
+            self._kv_transfer_ms["import"] += (time.monotonic() - t0) * 1000.0
+            EVENTS.emit(
+                "kv_import", model=model_id, peer=peer, bytes=len(blob),
+                tokens=(imported or {}).get("tokens", 0),
+                trace_id=trace.trace_id,
+            )
+        except Exception as e:  # noqa: BLE001 — transfer failure must never fail the chat
+            self._kv_transfer_failures += 1
+            self._kv_transfer_ms["import"] += (time.monotonic() - t0) * 1000.0
+            log.warning(
+                "kv prefetch from %s failed (%s: %s); serving with local prefill",
+                peer, type(e).__name__, e,
+            )
+            EVENTS.emit(
+                "kv_transfer_failed", model=model_id, peer=peer,
+                cause=type(e).__name__, error=str(e)[:200],
+                trace_id=trace.trace_id,
+            )
+
     async def on_sync_model_from_bucket(self, msg: Msg) -> None:
         """sync_model_from_bucket {object_name, model_id?} — implements the
         README-only conceptual subject (/root/reference/README.md:286-318):
@@ -783,6 +1025,7 @@ class Worker:
         data = {
             "status": "draining" if self.draining else "ok",
             "worker_id": self.worker_id,
+            "role": getattr(self.config, "worker_role", ""),
             "draining": self.draining,
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "requests_total": self._requests_total,
@@ -852,6 +1095,28 @@ class Worker:
                   help="completion tokens generated")
         r.counter("lmstudio_streams_cancelled_total", self._streams_cancelled,
                   help="streaming chats aborted because the consumer vanished")
+        # disaggregated prefill/decode families — ALWAYS present (zero-valued
+        # on monolithic workers) so a role dashboard can group the fleet and
+        # the disagg bench can scrape transfer volume without existence checks
+        r.gauge("lmstudio_worker_role", 1,
+                labels={"role": getattr(self.config, "worker_role", "") or "monolithic"},
+                help="info gauge: this worker's serving role "
+                     "(prefill | decode | monolithic)")
+        for direction in ("export", "import"):
+            dl = {"direction": direction}
+            r.counter("lmstudio_kv_transfer_bytes_total",
+                      self._kv_transfer_bytes[direction], labels=dl,
+                      help="KV blob bytes moved between prefill and decode "
+                           "workers, by direction")
+            r.counter("lmstudio_kv_transfer_ms_total",
+                      round(self._kv_transfer_ms[direction], 3), labels=dl,
+                      help="wall milliseconds spent in KV transfers, by "
+                           "direction (export: gather+ship; import: "
+                           "pull+verify+pool write)")
+        r.counter("lmstudio_kv_transfer_failures_total",
+                  self._kv_transfer_failures,
+                  help="KV pulls that failed (timeout, corrupt blob, pool "
+                       "exhaustion) and fell back to local prefill")
         reg = self.registry.stats()
         for key in ("models_cached", "models_loaded", "engine_requests",
                     "hbm_committed_bytes"):
@@ -1074,7 +1339,11 @@ class Worker:
         if want is not None and not engines:
             await self._respond_error(msg, f"model not loaded: {want}")
             return
-        await self._respond_ok(msg, {"worker_id": self.worker_id, "engines": engines})
+        await self._respond_ok(msg, {
+            "worker_id": self.worker_id,
+            "role": getattr(self.config, "worker_role", ""),
+            "engines": engines,
+        })
 
     async def on_debug_dump(self, msg: Msg) -> None:
         """debug.dump — force a flight-recorder dump for every loaded engine

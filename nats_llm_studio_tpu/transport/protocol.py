@@ -220,6 +220,15 @@ WORKER_HEADER = "X-Worker-Id"
 EXCLUDED_WORKERS_HEADER = "X-Excluded-Workers"
 
 
+# disaggregated prefill/decode (serve/worker.py + serve/router.py): the
+# router stamps the chosen prefill-role worker's id on the chat request it
+# steers at a decode-role worker. The decode worker pulls the prompt's
+# exported KV blocks from ``{prefix}.worker.<id>.kv_export`` before serving;
+# any transfer failure falls back to local prefill, so a stale or bogus
+# value degrades cleanly instead of failing the request.
+KV_PREFILL_HEADER = "X-KV-Prefill-Worker"
+
+
 # consumer-gone signal for streaming replies: when a streaming consumer
 # abandons its inbox before the terminal Nats-Stream-Done message, the
 # client publishes an empty message to ``<inbox> + STREAM_CANCEL_SUFFIX``.

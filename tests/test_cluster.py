@@ -39,21 +39,24 @@ class SheddingRegistry(FakeRegistry):
 class ClusterHarness:
     """N workers (fast adverts) + one client on an embedded broker."""
 
-    def __init__(self, n_workers=2, registries=None, advert_interval_s=0.05):
+    def __init__(self, n_workers=2, registries=None, advert_interval_s=0.05,
+                 roles=None):
         self.n_workers = n_workers
         self.registries = registries
         self.advert_interval_s = advert_interval_s
+        self.roles = roles  # optional per-worker WORKER_ROLE list
 
     async def __aenter__(self):
         self.broker = await EmbeddedBroker().start()
         if self.registries is None:
             self.registries = [FakeRegistry() for _ in range(self.n_workers)]
         self.workers = []
-        for reg in self.registries:
+        for i, reg in enumerate(self.registries):
             w = Worker(
                 WorkerConfig(
                     nats_url=self.broker.url,
                     cluster_advert_interval_s=self.advert_interval_s,
+                    worker_role=(self.roles[i] if self.roles else ""),
                 ),
                 reg,
             )
@@ -155,6 +158,46 @@ def test_router_pick_ranking_staleness_and_mark_dead():
     assert r2.pick(model="m", messages=msgs) is None
 
 
+def test_router_pick_pair_role_routing():
+    """Role-aware pick_pair (ISSUE 13): prefill-role workers are held out
+    of serving whenever any other worker is live, decode-role winners get
+    paired with the best prefill peer (the two-hop), and everything
+    degrades to monolithic picks when the topology loses a role."""
+    r = ClusterRouter(None, stale_after_s=5.0)
+
+    # roleless cluster: plain pick, never a prefill peer
+    r.ingest({"worker_id": "w-a", "queue_depth": 0})
+    assert r.pick_pair(model="m") == ("w-a", None)
+
+    # prefill-role workers don't serve chats while any other worker is live
+    r.ingest({"worker_id": "w-p", "queue_depth": 0, "role": "prefill"})
+    assert r.pick_pair(model="m")[0] == "w-a"
+
+    # a decode-role winner is paired with the best prefill peer
+    r.ingest({"worker_id": "w-d", "queue_depth": 5, "role": "decode",
+              "models": ["m"]})
+    assert r.pick_pair(model="m") == ("w-d", "w-p")
+    assert r.stats.two_hop_total == 1
+    assert r.pick(model="m") == "w-d"  # pick() delegates to pick_pair()
+
+    # a SHED_ONLY prefill peer is not worth the hop
+    r.ingest({"worker_id": "w-p", "queue_depth": 0, "role": "prefill",
+              "brownout": 2})
+    assert r.pick_pair(model="m") == ("w-d", None)
+    r.ingest({"worker_id": "w-p", "queue_depth": 0, "role": "prefill"})
+
+    # a monolithic winner never hops
+    r.ingest({"worker_id": "w-a", "queue_depth": 0, "models": ["m"]})
+    assert r.pick_pair(model="m") == ("w-a", None)
+
+    # only prefill-role workers left: they serve monolithically (degrade)
+    r.mark_dead("w-a")
+    r.mark_dead("w-d")
+    assert r.pick_pair(model="m") == ("w-p", None)
+    # exclusion applies to the serving end as usual
+    assert r.pick_pair(model="m", excluded=["w-p"]) == (None, None)
+
+
 # -- adverts + steering over the real broker ---------------------------------
 
 
@@ -188,6 +231,32 @@ async def test_worker_adverts_populate_router_and_steer():
         assert json.loads(msg.payload)["ok"] is True
         assert cold.stats.fallback_total == 1
         assert cold.stats.routed_total == 0
+
+
+@async_test
+async def test_role_cluster_degrades_gracefully_without_kv_engines():
+    """A prefill+decode topology over engines that can't export/import KV
+    (fakes.EchoEngine has no import_prefix hook) still serves every chat:
+    the router two-hops to the decode worker, which skips the pull without
+    counting a transfer failure."""
+    async with ClusterHarness(n_workers=2, roles=["prefill", "decode"]) as h:
+        router = await ClusterRouter(h.nc).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while len(router.members()) < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            roles = {m.worker_id: m.role for m in router.members()}
+            assert sorted(roles.values()) == ["decode", "prefill"]
+            decode_wid = next(w for w, role in roles.items() if role == "decode")
+
+            msg = await router.request_chat(h.chat(), timeout=5.0)
+            assert json.loads(msg.payload)["ok"] is True
+            assert (msg.headers or {}).get(p.WORKER_HEADER) == decode_wid
+            assert router.stats.two_hop_total == 1
+            wd = next(w for w in h.workers if w.worker_id == decode_wid)
+            assert wd._kv_transfer_failures == 0
+        finally:
+            await router.stop()
 
 
 @async_test

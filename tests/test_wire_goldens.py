@@ -155,6 +155,156 @@ def test_serializer_golden_bytes():
     assert p.PING == b"PING\r\n" and p.PONG == b"PONG\r\n" and p.OK == b"+OK\r\n"
 
 
+# ---------------------------------------------------------------------------
+# KV transfer blob goldens (disaggregated prefill/decode, serve/kv_transfer.py)
+# ---------------------------------------------------------------------------
+#
+# The KVX1 byte layout is a cross-worker wire contract: a prefill worker on
+# one build must produce bytes a decode worker on another build can import.
+# These goldens pin the exact serialization of a dense-bf16 export and a KVQ
+# (int8 codes + f32 scales) export; any byte-level change MUST bump the magic
+# and regenerate these fixtures (see the module docstring of kv_transfer.py).
+
+GOLDEN_KV_DENSE_BF16 = bytes.fromhex(
+    "4b565831a30000007b226368756e6b5f746f6b656e73223a342c226474797065223a2262"
+    "666c6f61743136222c226b5f7368617065223a5b312c322c312c342c325d2c226c61796f"
+    "7574223a2264656e7365222c226c6f67697473223a5b66616c73652c747275655d2c226e"
+    "5f6368756e6b73223a322c22746f6b656e5f696473223a5b312c322c332c342c352c362c"
+    "372c385d2c2276657273696f6e223a312c22766f636162223a347d0000003f803fc03f00"
+    "4020404040604080409040a040b040c040d040e040f040803fc03f004020404040604080"
+    "409040a040b040c040d040e040f04000410841004020404040604080409040a040b040c0"
+    "40d040e040f04000410841104118414040604080409040a040b040c040d040e040f04000"
+    "41084110411841204128410000003e0000c0bf000040400000403f"
+)
+
+GOLDEN_KV_KVQ_INT8 = bytes.fromhex(
+    "4b565831bb0000007b226368756e6b5f746f6b656e73223a342c226474797065223a2269"
+    "6e7438222c226b5f7368617065223a5b312c322c312c342c325d2c226c61796f7574223a"
+    "226b7671222c226c6f67697473223a5b747275655d2c226e5f6368756e6b73223a312c22"
+    "735f7368617065223a5b312c322c312c345d2c227363616c655f6474797065223a22666c"
+    "6f61743332222c22746f6b656e5f696473223a5b352c362c372c385d2c2276657273696f"
+    "6e223a312c22766f636162223a327df8f9fafbfcfdfeff00010203040506070000003f00"
+    "00403f0000803f0000a03f0000c03f0000e03f0000004000001040f9fafbfcfdfeff0001"
+    "020304050607080000003f0000403f0000803f0000a03f0000c03f0000e03f0000004000"
+    "00104000000040000000bf"
+)
+
+
+def _golden_dense_export():
+    import ml_dtypes
+    import numpy as np
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+
+    def leaf(seed):
+        return (
+            np.arange(16, dtype=np.float32).reshape(1, 2, 1, 4, 2) * 0.5 + seed
+        ).astype(bf16)
+
+    return {
+        "token_ids": list(range(1, 9)),
+        "chunk_tokens": 4,
+        "chunks": [
+            {"k": leaf(0.0), "v": leaf(1.0), "logits": None},
+            {"k": leaf(2.0), "v": leaf(3.0),
+             "logits": np.array([0.125, -1.5, 3.0, 0.75], dtype=np.float32)},
+        ],
+    }
+
+
+def _golden_kvq_export():
+    import numpy as np
+
+    def leaf(seed):
+        q = (
+            np.arange(16, dtype=np.int16).reshape(1, 2, 1, 4, 2) - 8 + seed
+        ).astype(np.int8)
+        s = np.arange(8, dtype=np.float32).reshape(1, 2, 1, 4) * 0.25 + 0.5
+        return (q, s)
+
+    return {
+        "token_ids": [5, 6, 7, 8],
+        "chunk_tokens": 4,
+        "chunks": [
+            {"k": leaf(0), "v": leaf(1),
+             "logits": np.array([2.0, -0.5], dtype=np.float32)},
+        ],
+    }
+
+
+@pytest.mark.parametrize(
+    "build,golden",
+    [
+        (_golden_dense_export, GOLDEN_KV_DENSE_BF16),
+        (_golden_kvq_export, GOLDEN_KV_KVQ_INT8),
+    ],
+    ids=["dense-bf16", "kvq-int8"],
+)
+def test_kv_blob_golden_bytes(build, golden):
+    """Byte-exact serialization of the two KV layouts a transfer can carry."""
+    from nats_llm_studio_tpu.serve.kv_transfer import encode_kv_blob
+
+    blob = encode_kv_blob(build())
+    assert blob[:4] == b"KVX1"
+    assert blob == golden
+
+
+@pytest.mark.parametrize(
+    "build,golden",
+    [
+        (_golden_dense_export, GOLDEN_KV_DENSE_BF16),
+        (_golden_kvq_export, GOLDEN_KV_KVQ_INT8),
+    ],
+    ids=["dense-bf16", "kvq-int8"],
+)
+def test_kv_blob_golden_decodes(build, golden):
+    """The pinned golden bytes decode back to the source arrays bit-exactly
+    (a FUTURE build must keep decoding blobs shipped by this one)."""
+    import numpy as np
+
+    from nats_llm_studio_tpu.serve.kv_transfer import decode_kv_blob
+
+    want = build()
+    got = decode_kv_blob(golden)
+    assert got["token_ids"] == want["token_ids"]
+    assert got["chunk_tokens"] == want["chunk_tokens"]
+    assert len(got["chunks"]) == len(want["chunks"])
+    for gc, wc in zip(got["chunks"], want["chunks"]):
+        for name in ("k", "v"):
+            if isinstance(wc[name], tuple):
+                assert np.array_equal(gc[name][0], wc[name][0])
+                assert np.array_equal(gc[name][1], wc[name][1])
+                assert gc[name][0].dtype == wc[name][0].dtype
+            else:
+                assert gc[name].dtype == wc[name].dtype
+                assert np.array_equal(
+                    gc[name].view(np.uint16), wc[name].view(np.uint16)
+                )
+        if wc["logits"] is None:
+            assert gc["logits"] is None
+        else:
+            assert np.array_equal(gc["logits"], wc["logits"])
+
+
+def test_kv_blob_rejects_corruption():
+    """Malformed blobs must raise KVTransferFormatError, never import."""
+    from nats_llm_studio_tpu.serve.kv_transfer import (
+        KVTransferFormatError,
+        decode_kv_blob,
+    )
+
+    good = GOLDEN_KV_DENSE_BF16
+    with pytest.raises(KVTransferFormatError):
+        decode_kv_blob(b"NOPE" + good[4:])  # bad magic
+    with pytest.raises(KVTransferFormatError):
+        decode_kv_blob(good[:-3])  # truncated body
+    with pytest.raises(KVTransferFormatError):
+        decode_kv_blob(good + b"\x00")  # trailing bytes
+    with pytest.raises(KVTransferFormatError):
+        # header length pointing past the end of the blob
+        decode_kv_blob(good[:4] + b"\xff\xff\xff\x7f" + good[8:])
+
+
 def test_serializer_roundtrip_through_parser():
     """Everything we emit must parse back identically (self-consistency on
     top of the golden shapes)."""
