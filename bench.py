@@ -1980,6 +1980,7 @@ def cluster_bench(*, n_workers: int | None = None, n_clients: int | None = None,
     from pathlib import Path
 
     from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.obs import bucket_pairs, merge
     from nats_llm_studio_tpu.serve import Worker
     from nats_llm_studio_tpu.serve.registry import LocalRegistry
     from nats_llm_studio_tpu.store.manager import ModelStore
@@ -2007,27 +2008,12 @@ def cluster_bench(*, n_workers: int | None = None, n_clients: int | None = None,
         )
 
     def ttft_p95(prom_texts: list[str]) -> float:
-        """p95 from the merged cumulative lmstudio_ttft_ms buckets (upper
-        bucket edge — resolution-honest, no interpolation)."""
-        edges: dict[str, float] = {}
-        for text in prom_texts:
-            for line in text.splitlines():
-                if not line.startswith("lmstudio_ttft_ms_bucket"):
-                    continue
-                i = line.index('le="') + 4
-                le = line[i:line.index('"', i)]
-                edges[le] = edges.get(le, 0.0) + float(line.rsplit(None, 1)[1])
-        pairs = sorted(
-            (float("inf") if le == "+Inf" else float(le), c)
-            for le, c in edges.items()
-        )
-        total = pairs[-1][1] if pairs else 0.0
-        if total <= 0:
-            return 0.0
-        for le, c in pairs:
-            if c >= 0.95 * total and le != float("inf"):
-                return le
-        return pairs[-2][0] if len(pairs) > 1 else 0.0
+        """p95 from the workers' lmstudio_ttft_ms buckets via the shared
+        delta-first merge (nats_llm_studio_tpu.obs.merge — upper bucket
+        edge, resolution-honest, no interpolation)."""
+        return merge(
+            bucket_pairs(t, "lmstudio_ttft_ms") for t in prom_texts
+        ).quantile(0.95)
 
     async def spawn(broker, models_dir: Path, wid: str):
         registry = LocalRegistry(
@@ -2213,6 +2199,7 @@ def disagg_bench(*, n_clients: int | None = None,
     from pathlib import Path
 
     from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.obs import bucket_pairs, merge
     from nats_llm_studio_tpu.serve import Worker
     from nats_llm_studio_tpu.serve.registry import LocalRegistry
     from nats_llm_studio_tpu.serve.router import ClusterRouter
@@ -2236,55 +2223,16 @@ def disagg_bench(*, n_clients: int | None = None,
         )
 
     def hist_stats(texts: list[str], family: str) -> dict:
-        """Mean/variance/p95 across N workers' log-histogram buckets.
-
-        Each text's cumulative buckets are converted to per-bucket deltas
-        FIRST — renderers elide empty buckets, so merging cumulative
-        counts by edge across workers is non-monotonic garbage — then the
-        deltas merge. Mean and variance use bucket midpoints (the +Inf
-        bucket collapses to the last finite edge); p95 is the upper
-        bucket edge, matching the resolution-honest convention of the
-        cluster phase."""
-        samples: list[tuple[float, float]] = []  # (midpoint, count)
-        deltas: dict[float, float] = {}  # finite upper edge -> count
-        for text in texts:
-            pairs = []
-            for line in text.splitlines():
-                if not line.startswith(family + "_bucket"):
-                    continue
-                i = line.index('le="') + 4
-                le = line[i:line.index('"', i)]
-                edge = float("inf") if le == "+Inf" else float(le)
-                pairs.append((edge, float(line.rsplit(None, 1)[1])))
-            prev_edge, prev_cum = 0.0, 0.0
-            for edge, cum in sorted(pairs):
-                n = cum - prev_cum
-                if n > 0:
-                    if edge == float("inf"):
-                        mid_v = upper = prev_edge
-                    else:
-                        mid_v = (prev_edge + edge) / 2
-                        upper = edge
-                    samples.append((mid_v, n))
-                    deltas[upper] = deltas.get(upper, 0.0) + n
-                prev_cum = cum
-                if edge != float("inf"):
-                    prev_edge = edge
-        count = sum(n for _, n in samples)
-        if count <= 0:
+        """Mean/variance/p95 across N workers' log-histogram buckets via
+        the shared delta-first merge (nats_llm_studio_tpu.obs.merge holds
+        the elision and +Inf-collapse rules this bench used to hand-roll)."""
+        m = merge(bucket_pairs(t, family) for t in texts)
+        if m.count <= 0:
             return {"count": 0, "mean_ms": 0.0, "std_ms": 0.0,
                     "var": 0.0, "p95_ms": 0.0}
-        mean = sum(v * n for v, n in samples) / count
-        var = sum(n * (v - mean) ** 2 for v, n in samples) / count
-        cum_n, p95 = 0.0, 0.0
-        for edge, n in sorted(deltas.items()):
-            cum_n += n
-            if cum_n >= 0.95 * count:
-                p95 = edge
-                break
-        return {"count": int(count), "mean_ms": round(mean, 3),
-                "std_ms": round(var ** 0.5, 3), "var": round(var, 4),
-                "p95_ms": round(p95, 3)}
+        return {"count": int(m.count), "mean_ms": round(m.mean, 3),
+                "std_ms": round(m.std, 3), "var": round(m.variance, 4),
+                "p95_ms": round(m.quantile(0.95), 3)}
 
     async def spawn(broker, models_dir: Path, wid: str, role: str):
         registry = LocalRegistry(
@@ -2639,6 +2587,151 @@ def gateway_bench(*, n_reqs: int | None = None,
         return asyncio.run(run(Path(td) / "models"))
 
 
+def obs_cluster_bench(*, n_reqs: int | None = None,
+                      max_new: int | None = None) -> dict:
+    """Cluster observability plane (ISSUE 14): a 1-prefill + 1-decode role
+    topology served through the steered ClusterRouter with the fleet
+    Aggregator attached. Exercises the plane end to end and reports what
+    it claims: (a) the aggregator's cluster-merged TTFT p95 must agree
+    with this bench's own delta-first merge over the SAME scrape — they
+    share nats_llm_studio_tpu.obs.merge, so the phase asserts equality,
+    not closeness; (b) a served two-hop chat queried back through
+    ``lmstudio.debug.trace.<trace_id>`` must come back as ONE assembled
+    tree whose stages cover the steering attempt, the decode serve, the
+    decode-side KV pull, and the prefill-side KV export."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from nats_llm_studio_tpu.config import WorkerConfig
+    from nats_llm_studio_tpu.obs import Aggregator, bucket_pairs, merge
+    from nats_llm_studio_tpu.serve import Worker
+    from nats_llm_studio_tpu.serve.registry import LocalRegistry
+    from nats_llm_studio_tpu.serve.router import ClusterRouter
+    from nats_llm_studio_tpu.store.manager import ModelStore
+    from nats_llm_studio_tpu.transport import EmbeddedBroker, RetryPolicy, connect
+
+    mid = "bench/obs-cluster-tiny"
+    n_reqs = n_reqs or int(os.environ.get("BENCH_OBS_CLUSTER_REQS", "4"))
+    max_new = max_new or int(os.environ.get("BENCH_OBS_CLUSTER_NEW", "8"))
+
+    async def spawn(broker, models_dir: Path, wid: str, role: str):
+        registry = LocalRegistry(
+            ModelStore(models_dir), dtype="float32", max_batch_slots=2,
+            max_seq_len=64, worker_id=wid,
+            # whole tiny prompts must cover full chunks or nothing is
+            # exportable and the trace never grows its kv hops
+            prefill_chunk=8, prefix_cache_blocks=32,
+        )
+        worker = Worker(
+            WorkerConfig(
+                nats_url=broker.url, worker_id=wid, worker_role=role,
+                cluster_advert_interval_s=0.2,
+                supervise_interval_s=0.1, engine_heartbeat_timeout_s=0.0,
+            ),
+            registry,
+        )
+        await worker.start()
+        return worker
+
+    async def run(models_dir: Path) -> dict:
+        _export_tiny_gguf(models_dir, mid)
+        broker = await EmbeddedBroker().start()
+        roles = {"w-obs-p": "prefill", "w-obs-d": "decode"}
+        workers = [await spawn(broker, models_dir, wid, role)
+                   for wid, role in roles.items()]
+        nc = await connect(broker.url, reconnect_wait_s=0.02,
+                           reconnect_max_wait_s=0.2)
+        router = await ClusterRouter(nc).start()
+        agg = Aggregator(nc, scrape_interval_s=0.2)
+        # no scrape loop: the phase drives scrape_once() itself so the
+        # p95-parity comparison runs against one known scrape
+        await agg.start(scrape_loop=False)
+        try:
+            deadline = time.monotonic() + 10.0
+            while ((len(router.members()) < len(roles)
+                    or len(agg.live_workers()) < len(roles))
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.05)
+            retry = RetryPolicy(max_attempts=6, backoff_s=0.05,
+                                max_backoff_s=0.5, retry_on_timeout=True)
+            served, trace_ids = 0, []
+            for i in range(n_reqs):
+                body = json.dumps({
+                    "model": mid,
+                    "messages": [{"role": "user",
+                                  "content": f"obs cluster probe {i}"}],
+                    "max_tokens": max_new, "temperature": 0.0, "stream": False,
+                }).encode()
+                msg = await router.request_chat(body, timeout=60.0, retry=retry)
+                r = json.loads(msg.payload)
+                if r.get("ok"):
+                    served += 1
+                    tid = (r["data"]["response"].get("stats") or {}).get(
+                        "trace", {}).get("trace_id")
+                    if tid:
+                        trace_ids.append(tid)
+
+            # span batches are fire-and-forget: give the last flush a beat,
+            # then poll until the newest trace shows its kv hops
+            tree: dict = {}
+            if trace_ids:
+                q = f"lmstudio.debug.trace.{trace_ids[-1]}"
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    resp = json.loads(
+                        (await nc.request(q, b"", timeout=5)).payload)
+                    tree = resp.get("data") or {}
+                    if tree.get("span_count", 0) >= 4:
+                        break
+                    await asyncio.sleep(0.1)
+            stages: set[str] = set()
+
+            def walk(nodes: list) -> None:
+                for n in nodes:
+                    if n.get("stage"):
+                        stages.add(n["stage"])
+                    walk(n.get("children") or [])
+
+            walk(tree.get("roots") or [])
+
+            texts = await agg.scrape_once()
+            bench_p95 = merge(
+                bucket_pairs(t, "lmstudio_ttft_ms") for t in texts.values()
+            ).quantile(0.95)
+            agg_p95 = next(
+                (float(line.rsplit(None, 1)[1])
+                 for line in agg.render_cluster().splitlines()
+                 if line.startswith("lmstudio_cluster_ttft_p95_ms")), -1.0)
+            return {
+                "served": served,
+                "scraped_workers": len(texts),
+                "agg_ttft_p95_ms": agg_p95,
+                "merge_ttft_p95_ms": round(bench_p95, 3),
+                "p95_match": agg_p95 == round(bench_p95, 3),
+                "trace_span_count": tree.get("span_count", 0),
+                "trace_stages": sorted(stages),
+                "two_hop_trace": {"router.attempt", "worker.serve",
+                                  "worker.kv_pull",
+                                  "worker.kv_export"} <= stages,
+                "spans_ingested": agg.spans.spans_total,
+                "slo_alerts": agg.alerts_total,
+            }
+        finally:
+            await agg.stop()
+            await router.stop()
+            await nc.close()
+            for w in workers:
+                try:
+                    await w.drain()
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass
+            await broker.stop()
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(run(Path(td) / "models"))
+
+
 FINAL_LINE_BUDGET = 2000  # harness line-buffer bound on the final JSON line
 
 
@@ -2843,6 +2936,13 @@ def main() -> None:
             _run_phase(tiny_detail, "gateway", lambda: gateway_bench(
                 n_reqs=4, max_new=12,
             ))
+        if os.environ.get("BENCH_OBS_CLUSTER", "1") != "0":
+            # micro-run of the cluster observability phase: assembled
+            # two-hop trace + aggregator-vs-bench TTFT p95 parity (CI
+            # smoke asserts the phase lands in the detail)
+            _run_phase(tiny_detail, "obs_cluster", lambda: obs_cluster_bench(
+                n_reqs=3, max_new=8,
+            ))
         _print_final({
             "metric": "tiny_smoke_decode_tok_s",
             "value": r["tok_s"], "unit": "tok/s/chip",
@@ -2976,6 +3076,11 @@ def main() -> None:
     # -- gateway: HTTP hop TTFT, constrained-mask cost, n fan-out HBM --------
     if os.environ.get("BENCH_GATEWAY", "1") != "0":
         _run_phase(detail, "gateway", gateway_bench)
+        gc.collect()
+
+    # -- obs_cluster: assembled two-hop trace + aggregator p95 parity --------
+    if os.environ.get("BENCH_OBS_CLUSTER", "1") != "0":
+        _run_phase(detail, "obs_cluster", obs_cluster_bench)
         gc.collect()
 
     del params

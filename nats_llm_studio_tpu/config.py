@@ -274,6 +274,42 @@ class WorkerConfig:
     gateway_max_conn: int = field(
         default_factory=lambda: int(_env("GATEWAY_MAX_CONN", "256"))
     )
+    # -- cluster observability plane (obs/aggregator.py + obs/trace.py) -------
+    # kill switch for cross-process span emission: when off, gateway/router/
+    # worker skip publishing span batches to {prefix}.obs.spans entirely
+    # (Traceparent headers still flow — they cost nothing)
+    obs_spans: bool = field(
+        default_factory=lambda: _env("OBS_SPANS", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    # fleet aggregator scrape cadence: how often the collector requests each
+    # live worker's directed metrics.prom subject
+    obs_scrape_interval_s: float = field(
+        default_factory=lambda: float(_env("OBS_SCRAPE_INTERVAL_S", "2.0"))
+    )
+    # embed the fleet aggregator inside ``python -m nats_llm_studio_tpu
+    # route`` (one fewer process for small clusters); the standalone
+    # ``... obs`` subcommand ignores this knob and always runs one
+    obs_aggregator: bool = field(
+        default_factory=lambda: _env("OBS_AGGREGATOR", "0").strip().lower()
+        in ("1", "true", "on")
+    )
+    # SLO objectives evaluated by the aggregator over fast/slow burn windows:
+    # cluster TTFT p95 target (ms), slow window length (s; the fast window is
+    # window/12 clamped to at least two scrape intervals), minimum
+    # served-or-retryable ratio, and maximum shed rate
+    slo_ttft_p95_ms: float = field(
+        default_factory=lambda: float(_env("SLO_TTFT_P95_MS", "2000"))
+    )
+    slo_window_s: float = field(
+        default_factory=lambda: float(_env("SLO_WINDOW_S", "60"))
+    )
+    slo_served_ratio: float = field(
+        default_factory=lambda: float(_env("SLO_SERVED_RATIO", "0.99"))
+    )
+    slo_shed_ratio: float = field(
+        default_factory=lambda: float(_env("SLO_SHED_RATIO", "0.05"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

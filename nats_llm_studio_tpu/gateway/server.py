@@ -6,6 +6,7 @@ server (no web framework — the container ships none) and translates:
     POST /v1/chat/completions   -> ClusterRouter.request_chat[_stream]
     GET  /v1/models             -> {prefix}.list_models
     GET  /healthz               -> gateway + cluster-membership liveness
+    GET  /metrics               -> Prometheus exposition (HTTP-edge view)
 
 so any unmodified OpenAI client (``openai`` SDK, curl, LangChain) can talk
 to a worker cluster without importing this package. Streaming responses are
@@ -34,10 +35,18 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any
 
-from ..obs import new_trace_id
+from ..obs import (
+    LogHistogram,
+    PromRenderer,
+    Span,
+    new_span_id,
+    new_trace_id,
+    span_context_value,
+)
 from ..serve.constrain import validate_response_format
 from ..serve.router import ClusterRouter, RouterExhausted
 from ..transport import ConnectionClosedError, NatsClient, RetryPolicy
@@ -172,6 +181,8 @@ class Gateway:
         router: ClusterRouter | None = None,
         stale_after_s: float = 5.0,
         prefix_head_chars: int = 256,
+        obs_spans: bool | None = None,
+        ident: str = "gateway",
     ):
         self.nc = nc
         self.prefix = prefix
@@ -186,11 +197,25 @@ class Gateway:
             stale_after_s=stale_after_s,
             prefix_head_chars=prefix_head_chars,
         )
+        if obs_spans is None:
+            obs_spans = os.environ.get(
+                "OBS_SPANS", "1"
+            ).strip().lower() not in ("0", "false", "off")
+        self.obs_spans = obs_spans
+        self.ident = ident  # worker_id stamped on this gateway's spans
         self._sem = asyncio.Semaphore(max(1, max_conn))
         self._server: asyncio.base_events.Server | None = None
         self.requests_total = 0
         self.streams_total = 0
         self.client_disconnects = 0
+        self.retry_hops_total = 0  # extra attempts behind served replies
+        self.sse_open = 0  # SSE streams currently being written
+        self._responses_by_status: dict[int, int] = {}
+        # TTFT as the HTTP client experiences it: request-line read to
+        # first response byte (full reply for non-streaming, SSE preamble
+        # for streams) — the edge-side counterpart of the workers'
+        # lmstudio_ttft_ms, including routing, retries, and queueing
+        self._ttft_ms = LogHistogram()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -272,6 +297,9 @@ class Gateway:
                 "requests_total": self.requests_total,
             })
             return
+        if method == "GET" and path == "/metrics":
+            await self._respond_text(writer, 200, self.render_prometheus())
+            return
         if method == "GET" and path == "/v1/models":
             await self._get_models(writer)
             return
@@ -333,11 +361,36 @@ class Gateway:
         status: int,
         body: dict,
         extra: dict[str, str] | None = None,
-    ) -> None:
+    ) -> int:
         raw = json.dumps(body, separators=(",", ":")).encode()
+        await self._write_response(
+            writer, status, "application/json", raw, extra
+        )
+        return status
+
+    async def _respond_text(
+        self, writer: asyncio.StreamWriter, status: int, text: str
+    ) -> int:
+        await self._write_response(
+            writer, status, "text/plain; version=0.0.4; charset=utf-8",
+            text.encode(),
+        )
+        return status
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        content_type: str,
+        raw: bytes,
+        extra: dict[str, str] | None = None,
+    ) -> None:
+        self._responses_by_status[status] = (
+            self._responses_by_status.get(status, 0) + 1
+        )
         lines = [
             f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
-            "Content-Type: application/json",
+            f"Content-Type: {content_type}",
             f"Content-Length: {len(raw)}",
             "Connection: close",
         ]
@@ -345,6 +398,31 @@ class Gateway:
             lines.append(f"{k}: {v}")
         writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + raw)
         await writer.drain()
+
+    def render_prometheus(self) -> str:
+        """HTTP-edge metrics: statuses, streams, retry hops behind served
+        replies, and TTFT as the *client* saw it (routing + retries
+        included) — the complement of the workers' engine-side families."""
+        r = PromRenderer(default_labels={"gateway": self.ident})
+        r.counter("lmstudio_gateway_requests_total", self.requests_total,
+                  help="HTTP requests accepted (any route)")
+        for status in sorted(self._responses_by_status):
+            r.counter("lmstudio_gateway_responses_total",
+                      self._responses_by_status[status],
+                      labels={"status": str(status)},
+                      help="HTTP responses by status code")
+        r.counter("lmstudio_gateway_streams_total", self.streams_total,
+                  help="SSE chat streams started")
+        r.gauge("lmstudio_gateway_sse_open", self.sse_open,
+                help="SSE streams currently being written")
+        r.counter("lmstudio_gateway_client_disconnects_total",
+                  self.client_disconnects,
+                  help="clients gone before their response completed")
+        r.counter("lmstudio_gateway_retry_hops_total", self.retry_hops_total,
+                  help="extra routed attempts behind served chat replies")
+        r.histogram("lmstudio_gateway_ttft_ms", self._ttft_ms.snapshot(),
+                    help="request-line read to first response byte, ms")
+        return r.render()
 
     # -- routes --------------------------------------------------------------
 
@@ -406,14 +484,65 @@ class Gateway:
             return
         payload["stream"] = stream
         bus_headers = self._bus_headers(http_headers)
-        if stream:
-            await self._chat_stream(reader, writer, payload, bus_headers)
-        else:
-            await self._chat_once(writer, payload, bus_headers)
+        # the gateway span is the root of the cross-process trace: its id
+        # rides the Traceparent header so every router attempt (and, through
+        # it, every worker hop) parents under this request
+        trace_id = bus_headers[p.TRACE_HEADER]
+        root_span_id = new_span_id()
+        bus_headers[p.TRACEPARENT_HEADER] = span_context_value(
+            trace_id, root_span_id
+        )
+        span_t0 = time.time()
+        t0 = time.monotonic()
+        status = 0  # 0 = client gone before any response byte
+        try:
+            if stream:
+                status = await self._chat_stream(
+                    reader, writer, payload, bus_headers, t0
+                )
+            else:
+                status = await self._chat_once(writer, payload, bus_headers, t0)
+        finally:
+            self._emit_span(Span(
+                trace_id=trace_id, span_id=root_span_id,
+                stage="gateway.request", worker_id=self.ident,
+                t0=span_t0, t1=time.time(),
+                attrs={"model": payload.get("model", ""),
+                       "stream": stream, "status": status},
+            ).to_dict())
+
+    def _emit_span(self, span: dict) -> None:
+        """Fire-and-forget publish of the gateway root span; never fatal
+        (and never blocking the HTTP response path)."""
+        if not self.obs_spans:
+            return
+
+        async def _pub() -> None:
+            try:
+                await self.nc.publish(
+                    f"{self.prefix}.obs.spans",
+                    json.dumps({"spans": [span]}, separators=(",", ":")).encode(),
+                )
+            except (ConnectionError, ValueError):
+                pass
+
+        asyncio.ensure_future(_pub())
+
+    def _count_retry_hops(self, response: dict) -> None:
+        """Served replies report the winning attempt number in their trace
+        stats; anything past the first attempt was a retry hop."""
+        trace = (response.get("stats") or {}).get("trace") or {}
+        attempt = trace.get("attempt")
+        if isinstance(attempt, int) and attempt > 1:
+            self.retry_hops_total += attempt - 1
 
     async def _chat_once(
-        self, writer: asyncio.StreamWriter, payload: dict, bus_headers: dict[str, str]
-    ) -> None:
+        self,
+        writer: asyncio.StreamWriter,
+        payload: dict,
+        bus_headers: dict[str, str],
+        t0: float,
+    ) -> int:
         try:
             msg = await self.router.request_chat(
                 payload,
@@ -424,43 +553,41 @@ class Gateway:
             )
             env = json.loads(msg.payload or b"{}")
         except RouterExhausted as e:
-            await self._respond_exhausted(writer, e)
-            return
+            return await self._respond_exhausted(writer, e)
         except (asyncio.TimeoutError, ConnectionClosedError) as e:
-            await self._respond(
+            return await self._respond(
                 writer, 503,
                 _error_body(f"no worker answered: {e}", "overloaded_error",
                             "worker_unavailable"),
                 extra={"Retry-After": "1"},
             )
-            return
         except ValueError:
-            await self._respond(
+            return await self._respond(
                 writer, 500, _error_body("worker reply was not JSON", "api_error")
             )
-            return
         if not env.get("ok"):
             status, etype, code = _status_for_error(str(env.get("error", "")))
             extra = {"Retry-After": "1"} if status == 503 else None
-            await self._respond(
+            return await self._respond(
                 writer, status,
                 _error_body(str(env.get("error")), etype, code), extra=extra,
             )
-            return
         response = (env.get("data") or {}).get("response") or {}
         response.setdefault("id", f"chatcmpl-{bus_headers[p.TRACE_HEADER]}")
         response.setdefault("created", int(time.time()))
-        await self._respond(writer, 200, response)
+        self._count_retry_hops(response)
+        self._ttft_ms.record((time.monotonic() - t0) * 1000.0)
+        return await self._respond(writer, 200, response)
 
     async def _respond_exhausted(
         self, writer: asyncio.StreamWriter, e: RouterExhausted
-    ) -> None:
+    ) -> int:
         retry_after = max(1, int(e.retry_after_s + 0.999))
         body = _error_body(e.detail(), "overloaded_error", "worker_unavailable")
         body["error"]["retry_after_s"] = retry_after
         if e.worker_id:
             body["error"]["last_worker"] = e.worker_id
-        await self._respond(
+        return await self._respond(
             writer, 503, body, extra={"Retry-After": str(retry_after)}
         )
 
@@ -472,7 +599,8 @@ class Gateway:
         writer: asyncio.StreamWriter,
         payload: dict,
         bus_headers: dict[str, str],
-    ) -> None:
+        t0: float,
+    ) -> int:
         self.streams_total += 1
         chat_id = f"chatcmpl-{bus_headers[p.TRACE_HEADER]}"
         created = int(time.time())
@@ -508,18 +636,16 @@ class Gateway:
                     break
                 except RouterExhausted as e:
                     if not preamble_sent:
-                        await self._respond_exhausted(writer, e)
-                        return
+                        return await self._respond_exhausted(writer, e)
                     raise
                 except (asyncio.TimeoutError, ConnectionClosedError) as e:
                     if not preamble_sent:
-                        await self._respond(
+                        return await self._respond(
                             writer, 503,
                             _error_body(f"no worker answered: {e}",
                                         "overloaded_error", "worker_unavailable"),
                             extra={"Retry-After": "1"},
                         )
-                        return
                     raise
                 terminal = bool(msg.headers and "Nats-Stream-Done" in msg.headers)
                 try:
@@ -532,19 +658,19 @@ class Gateway:
                         if not preamble_sent:
                             status, etype, code = _status_for_error(err)
                             extra = {"Retry-After": "1"} if status == 503 else None
-                            await self._respond(
+                            return await self._respond(
                                 writer, status, _error_body(err, etype, code),
                                 extra=extra,
                             )
-                            return
                         # headers are gone: surface the error in-band, the
                         # way api.openai.com does mid-stream
                         await self._sse(writer, {"error": _error_body(
                             err, *_status_for_error(err)[1:])["error"]})
                         break
                     response = (env.get("data") or {}).get("response") or {}
+                    self._count_retry_hops(response)
                     if not preamble_sent:
-                        await self._sse_preamble(writer)
+                        await self._sse_start(writer, t0)
                         preamble_sent = True
                     for choice in response.get("choices") or [{}]:
                         fin = {
@@ -568,7 +694,7 @@ class Gateway:
                 chunk.setdefault("id", chat_id)
                 chunk.setdefault("created", created)
                 if not preamble_sent:
-                    await self._sse_preamble(writer)
+                    await self._sse_start(writer, t0)
                     preamble_sent = True
                 await self._sse(writer, chunk)
             if preamble_sent and not disconnected:
@@ -585,8 +711,21 @@ class Gateway:
             # closing the router stream propagates consumer-gone down the
             # transport: the worker sees <inbox>.cancel and frees the slot
             await agen.aclose()
+            if preamble_sent:
+                self.sse_open -= 1
             if disconnected:
                 self.client_disconnects += 1
+        if disconnected and not preamble_sent:
+            return 499  # client closed before any response byte (nginx idiom)
+        return 200 if preamble_sent else 0
+
+    async def _sse_start(self, writer: asyncio.StreamWriter, t0: float) -> None:
+        """First SSE byte: the stream is now a committed 200 — count it,
+        open the gauge, and record client-perceived TTFT."""
+        await self._sse_preamble(writer)
+        self._responses_by_status[200] = self._responses_by_status.get(200, 0) + 1
+        self.sse_open += 1
+        self._ttft_ms.record((time.monotonic() - t0) * 1000.0)
 
     @staticmethod
     async def _sse_preamble(writer: asyncio.StreamWriter) -> None:

@@ -9,6 +9,7 @@ fact"). This CLI is that wiring, made first-class:
     python -m nats_llm_studio_tpu broker --port 4222 [--store-dir ./nats_data]
     python -m nats_llm_studio_tpu route                # standalone cluster router
     python -m nats_llm_studio_tpu gateway [--port 8080]  # OpenAI-compatible HTTP front door
+    python -m nats_llm_studio_tpu obs                  # fleet metrics/trace aggregator
     python -m nats_llm_studio_tpu publish <model.gguf> <publisher>/<name>
     python -m nats_llm_studio_tpu chat <model_id> "prompt..."
 
@@ -157,13 +158,66 @@ async def _run_route(args: argparse.Namespace) -> None:
         retry=RetryPolicy(max_attempts=args.max_attempts, retry_on_timeout=True),
     )
     await proc.start()
-    log.info("router on %s (prefix %s)", cfg.nats_url, cfg.subject_prefix)
+    agg = None
+    if cfg.obs_aggregator:
+        # OBS_AGGREGATOR=1 embeds the fleet collector in the router process
+        # (one fewer process for small clusters); it shares the connection
+        from .obs import Aggregator
+
+        agg = Aggregator(
+            nc,
+            prefix=cfg.subject_prefix,
+            scrape_interval_s=cfg.obs_scrape_interval_s,
+            stale_after_s=cfg.router_stale_after_s,
+            slo_ttft_p95_ms=cfg.slo_ttft_p95_ms,
+            slo_window_s=cfg.slo_window_s,
+            slo_served_ratio=cfg.slo_served_ratio,
+            slo_shed_ratio=cfg.slo_shed_ratio,
+        )
+        await agg.start()
+    log.info("router on %s (prefix %s%s)", cfg.nats_url, cfg.subject_prefix,
+             ", embedded aggregator" if agg is not None else "")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if agg is not None:
+        await agg.stop()
     await proc.stop()
+    await nc.close()
+
+
+async def _run_obs(args: argparse.Namespace) -> None:
+    """Standalone fleet observability collector (obs/aggregator.py): ingests
+    cluster adverts and span batches, scrapes every live worker's directed
+    metrics.prom subject, serves the merged cluster exposition on
+    ``{prefix}.cluster.metrics.prom`` and assembled traces on
+    ``{prefix}.debug.trace.<trace_id>``, and emits slo_burn events."""
+    from .obs import Aggregator
+    from .transport import connect
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url, name="tpu-obs")
+    agg = Aggregator(
+        nc,
+        prefix=cfg.subject_prefix,
+        scrape_interval_s=cfg.obs_scrape_interval_s,
+        stale_after_s=cfg.router_stale_after_s,
+        slo_ttft_p95_ms=cfg.slo_ttft_p95_ms,
+        slo_window_s=cfg.slo_window_s,
+        slo_served_ratio=cfg.slo_served_ratio,
+        slo_shed_ratio=cfg.slo_shed_ratio,
+    )
+    await agg.start()
+    log.info("aggregator on %s (prefix %s, scrape %.1fs)",
+             cfg.nats_url, cfg.subject_prefix, cfg.obs_scrape_interval_s)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await agg.stop()
     await nc.close()
 
 
@@ -267,6 +321,8 @@ def main(argv: list[str] | None = None) -> None:
     rp = sub.add_parser("route", help="run a standalone cluster router")
     rp.add_argument("--max-attempts", type=int, default=3)
 
+    sub.add_parser("obs", help="run the fleet metrics/trace aggregator")
+
     gw = sub.add_parser("gateway", help="run the OpenAI-compatible HTTP gateway")
     gw.add_argument("--host", default=None)
     gw.add_argument("--port", type=int, default=None)
@@ -289,6 +345,7 @@ def main(argv: list[str] | None = None) -> None:
         "broker": _run_broker,
         "route": _run_route,
         "gateway": _run_gateway,
+        "obs": _run_obs,
         "publish": _run_publish,
         "chat": _run_chat,
     }[args.cmd]

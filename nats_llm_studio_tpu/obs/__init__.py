@@ -1,18 +1,46 @@
 """Observability: trace context, bounded histograms, events, exposition.
 
 The subsystem PR 1 threads through every layer — see histogram.py,
-trace.py, events.py, prom.py. Import-light on purpose: nothing here may
-import jax or the transport (both import *us*).
+trace.py, events.py, prom.py, aggregator.py. Import-light on purpose:
+nothing here may import jax or the transport (both import *us*); the
+fleet aggregator takes an already-connected NATS client by injection.
 """
 
+from .aggregator import (
+    Aggregator,
+    SloEvaluator,
+    SpanStore,
+    assemble_trace,
+    merge_expositions,
+)
 from .compile_cache import compile_cache_counts, install_compile_cache_listener
 from .events import EVENTS, EventRing, emit
-from .histogram import HistSnapshot, LogHistogram
+from .histogram import (
+    HistSnapshot,
+    LogHistogram,
+    MergedHist,
+    bucket_pairs,
+    merge,
+    quantile,
+)
 from .prom import PromRenderer
 from .recorder import FlightRecorder
-from .trace import STAGES, Trace, new_trace_id
+from .trace import (
+    STAGES,
+    Span,
+    Trace,
+    new_span_id,
+    new_trace_id,
+    parse_span_context,
+    span_context_value,
+)
 
 __all__ = [
+    "Aggregator",
+    "SloEvaluator",
+    "SpanStore",
+    "assemble_trace",
+    "merge_expositions",
     "EVENTS",
     "EventRing",
     "emit",
@@ -21,8 +49,16 @@ __all__ = [
     "install_compile_cache_listener",
     "HistSnapshot",
     "LogHistogram",
+    "MergedHist",
+    "bucket_pairs",
+    "merge",
+    "quantile",
     "PromRenderer",
     "STAGES",
+    "Span",
     "Trace",
+    "new_span_id",
     "new_trace_id",
+    "parse_span_context",
+    "span_context_value",
 ]

@@ -36,11 +36,18 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from hashlib import blake2b
 
-from ..obs import new_trace_id
+from ..obs import (
+    Span,
+    new_span_id,
+    new_trace_id,
+    parse_span_context,
+    span_context_value,
+)
 from ..transport import ConnectionClosedError, Msg, NatsClient, RetryPolicy
 from ..transport import protocol as p
 from ..transport.envelope import (
@@ -202,12 +209,23 @@ class ClusterRouter:
         stale_after_s: float = 5.0,
         prefix_head_chars: int = DEFAULT_HEAD_CHARS,
         queue_group_fallback: bool = True,
+        obs_spans: bool | None = None,
+        ident: str = "router",
     ):
         self.nc = nc
         self.prefix = prefix
         self.stale_after_s = stale_after_s
         self.prefix_head_chars = prefix_head_chars
         self.queue_group_fallback = queue_group_fallback
+        # per-attempt steering spans on {prefix}.obs.spans; None defers to
+        # the OBS_SPANS env kill switch so bare ClusterRouter(nc) callers
+        # (tests, bench) inherit the fleet-wide setting
+        if obs_spans is None:
+            obs_spans = os.environ.get(
+                "OBS_SPANS", "1"
+            ).strip().lower() not in ("0", "false", "off")
+        self.obs_spans = obs_spans
+        self.ident = ident  # worker_id stamped on this router's spans
         self.stats = RouterStats()
         self._members: dict[str, WorkerAdvert] = {}
         self._sub = None
@@ -342,6 +360,20 @@ class ClusterRouter:
 
     # -- steered request-reply ----------------------------------------------
 
+    async def _emit_span(self, span: dict) -> None:
+        """Fire-and-forget publish of one steering span. Spans are
+        diagnostics, never load-bearing: a dropped connection loses the
+        span, not the request."""
+        if not self.obs_spans:
+            return
+        try:
+            await self.nc.publish(
+                f"{self.prefix}.obs.spans",
+                json.dumps({"spans": [span]}, separators=(",", ":")).encode(),
+            )
+        except (ConnectionError, ValueError):
+            pass
+
     async def request_chat(
         self,
         payload: dict | bytes,
@@ -376,6 +408,10 @@ class ClusterRouter:
         headers.setdefault(p.TRACE_HEADER, new_trace_id())
         headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
         deadline_hdr = headers[p.DEADLINE_HEADER]
+        trace_id = headers[p.TRACE_HEADER]
+        # the caller's span (gateway root, typically) parents every attempt
+        inbound = parse_span_context(headers.get(p.TRACEPARENT_HEADER))
+        parent_span_id = inbound[1] if inbound else ""
         excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
         fallback = f"{self.prefix}.chat_model"
         last_exc: BaseException | None = None
@@ -406,45 +442,65 @@ class ClusterRouter:
                 self.stats.fallback_total += 1
             else:
                 raise ConnectionClosedError("no live cluster members")
+            # each attempt is its own span; the worker parses this header and
+            # parents its serve span under the attempt that reached it, so
+            # retries and excluded-worker hops stay causally separate
+            span_id = new_span_id()
+            span_t0 = time.time()
+            headers[p.TRACEPARENT_HEADER] = span_context_value(trace_id, span_id)
+            attrs: dict = {"attempt": attempt,
+                           "worker": wid or "queue-group", "outcome": "ok"}
+            if headers.get(p.KV_PREFILL_HEADER):
+                attrs["prefill_worker"] = headers[p.KV_PREFILL_HEADER]
             try:
-                msg = await self.nc.request(
-                    subject, body, timeout=attempt_timeout, headers=headers
-                )
-            except ConnectionClosedError as e:
-                last_exc, last_msg = e, None
-            except asyncio.TimeoutError as e:
-                if not retry.retry_on_timeout:
-                    raise
-                last_exc, last_msg = e, None
-                if wid is not None:
-                    # a directed request that never answered: the worker is
-                    # likely dead (adverts will confirm); steer away now
-                    self.mark_dead(wid)
-                    if wid not in excluded:
-                        excluded.append(wid)
-            else:
-                if self._retryable(msg):
-                    # a retryable reply on the FINAL attempt still lands in
-                    # last_msg so the exhaustion site below decides whether
-                    # to return it raw or raise RouterExhausted
-                    last_exc, last_msg = None, msg
-                    if attempt >= retry.max_attempts:
-                        break
-                    shed_by = NatsClient._reply_worker_id(msg) or wid
-                    if shed_by and NatsClient._is_excluded_bounce(msg):
-                        # one-shot exclusion consumed (see client.request)
-                        if shed_by in excluded:
-                            excluded.remove(shed_by)
-                    elif shed_by and shed_by not in excluded:
-                        excluded.append(shed_by)
-                    if not excluded:
-                        headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
-                    if not await NatsClient._backoff_within_budget(
-                        retry.delay_s(attempt), deadline_hdr
-                    ):
-                        break
-                    continue
-                return msg
+                try:
+                    msg = await self.nc.request(
+                        subject, body, timeout=attempt_timeout, headers=headers
+                    )
+                except ConnectionClosedError as e:
+                    attrs["outcome"] = "conn_error"
+                    last_exc, last_msg = e, None
+                except asyncio.TimeoutError as e:
+                    attrs["outcome"] = "timeout"
+                    if not retry.retry_on_timeout:
+                        raise
+                    last_exc, last_msg = e, None
+                    if wid is not None:
+                        # a directed request that never answered: the worker is
+                        # likely dead (adverts will confirm); steer away now
+                        self.mark_dead(wid)
+                        if wid not in excluded:
+                            excluded.append(wid)
+                else:
+                    if self._retryable(msg):
+                        # a retryable reply on the FINAL attempt still lands in
+                        # last_msg so the exhaustion site below decides whether
+                        # to return it raw or raise RouterExhausted
+                        attrs["outcome"] = "retryable"
+                        last_exc, last_msg = None, msg
+                        if attempt >= retry.max_attempts:
+                            break
+                        shed_by = NatsClient._reply_worker_id(msg) or wid
+                        if shed_by and NatsClient._is_excluded_bounce(msg):
+                            # one-shot exclusion consumed (see client.request)
+                            if shed_by in excluded:
+                                excluded.remove(shed_by)
+                        elif shed_by and shed_by not in excluded:
+                            excluded.append(shed_by)
+                        if not excluded:
+                            headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
+                        if not await NatsClient._backoff_within_budget(
+                            retry.delay_s(attempt), deadline_hdr
+                        ):
+                            break
+                        continue
+                    return msg
+            finally:
+                await self._emit_span(Span(
+                    trace_id=trace_id, span_id=span_id, stage="router.attempt",
+                    worker_id=self.ident, parent_span_id=parent_span_id,
+                    t0=span_t0, t1=time.time(), attrs=attrs,
+                ).to_dict())
             if attempt >= retry.max_attempts:
                 break
             if not await NatsClient._backoff_within_budget(
@@ -507,6 +563,9 @@ class ClusterRouter:
         headers.setdefault(p.TRACE_HEADER, new_trace_id())
         headers.setdefault(p.DEADLINE_HEADER, deadline_header_value(timeout))
         deadline_hdr = headers[p.DEADLINE_HEADER]
+        trace_id = headers[p.TRACE_HEADER]
+        inbound = parse_span_context(headers.get(p.TRACEPARENT_HEADER))
+        parent_span_id = inbound[1] if inbound else ""
         excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
         fallback = f"{self.prefix}.chat_model"
         last_exc: BaseException | None = None
@@ -534,6 +593,13 @@ class ClusterRouter:
                 self.stats.fallback_total += 1
             else:
                 raise ConnectionClosedError("no live cluster members")
+            span_id = new_span_id()
+            span_t0 = time.time()
+            headers[p.TRACEPARENT_HEADER] = span_context_value(trace_id, span_id)
+            attrs: dict = {"attempt": attempt,
+                           "worker": wid or "queue-group", "outcome": "ok"}
+            if headers.get(p.KV_PREFILL_HEADER):
+                attrs["prefill_worker"] = headers[p.KV_PREFILL_HEADER]
             yielded = False
             retry_msg: Msg | None = None
             stream = self.nc.request_stream(
@@ -553,10 +619,12 @@ class ClusterRouter:
                     if terminal:
                         return
             except ConnectionClosedError as e:
+                attrs["outcome"] = "conn_error"
                 if yielded:
                     raise
                 last_exc, last_msg = e, None
             except asyncio.TimeoutError as e:
+                attrs["outcome"] = "timeout"
                 if yielded or not retry.retry_on_timeout:
                     raise
                 last_exc, last_msg = e, None
@@ -567,6 +635,7 @@ class ClusterRouter:
             else:
                 if retry_msg is None:
                     return  # stream ended cleanly (terminal already yielded)
+                attrs["outcome"] = "retryable"
                 last_exc, last_msg = None, retry_msg
                 shed_by = NatsClient._reply_worker_id(retry_msg) or wid
                 if shed_by and NatsClient._is_excluded_bounce(retry_msg):
@@ -580,6 +649,11 @@ class ClusterRouter:
                 # broke out (or the caller closed us): close the transport
                 # stream so its consumer-gone cancel reaches the worker
                 await stream.aclose()
+                await self._emit_span(Span(
+                    trace_id=trace_id, span_id=span_id, stage="router.attempt",
+                    worker_id=self.ident, parent_span_id=parent_span_id,
+                    t0=span_t0, t1=time.time(), attrs=attrs,
+                ).to_dict())
             if attempt >= retry.max_attempts:
                 break
             if not await NatsClient._backoff_within_budget(

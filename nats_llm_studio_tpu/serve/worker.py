@@ -37,9 +37,13 @@ from ..obs import (
     EVENTS,
     PromRenderer,
     Trace,
+    Span,
     compile_cache_counts,
     install_compile_cache_listener,
+    new_span_id,
     new_trace_id,
+    parse_span_context,
+    span_context_value,
 )
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
@@ -50,6 +54,7 @@ from ..transport.protocol import (
     KV_PREFILL_HEADER,
     STREAM_CANCEL_SUFFIX,
     TRACE_HEADER,
+    TRACEPARENT_HEADER,
     WORKER_HEADER,
     parse_worker_list,
 )
@@ -124,6 +129,12 @@ class Worker:
         self._slow_request_ms = float(
             os.environ.get("OBS_SLOW_REQUEST_MS", "5000").strip() or 0
         )
+        # -- cross-process spans (obs/trace.py + obs/aggregator.py) ----------
+        # spans emitted in one event-loop tick coalesce into a single batch
+        # publish on {prefix}.obs.spans; OBS_SPANS=0 disables emission
+        self._span_buf: list[dict] = []
+        self._span_flush_task: asyncio.Task | None = None
+        self._spans_emitted_total = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -531,7 +542,12 @@ class Worker:
             attempt = int(hdrs[ATTEMPT_HEADER]) if ATTEMPT_HEADER in hdrs else None
         except (TypeError, ValueError):
             attempt = None
-        trace = Trace(hdrs.get(TRACE_HEADER) or new_trace_id(), attempt=attempt)
+        # upstream span context (gateway/router Traceparent header): the
+        # serve span this handler emits becomes that hop's child, so the
+        # assembled cluster tree stays causally linked across retries
+        parent = parse_span_context(hdrs.get(TRACEPARENT_HEADER))
+        trace = Trace(hdrs.get(TRACE_HEADER) or new_trace_id(), attempt=attempt,
+                      parent_span_id=parent[1] if parent else "")
         trace.mark("recv")
         if self.worker_id in parse_worker_list(hdrs.get(EXCLUDED_WORKERS_HEADER)):
             # a queue-group redelivery landed the retry back on the worker
@@ -549,6 +565,10 @@ class Worker:
                 {"worker_id": self.worker_id, "excluded_bounce": True},
                 trace_id=trace.trace_id,
             )
+            # the bounce is a real hop of the retry story: without its span
+            # the assembled tree shows a hole where the redelivery landed
+            self._emit_span(trace.to_span("worker.serve", self.worker_id,
+                                          attrs={"outcome": "excluded_bounce"}))
             return
         if self.draining:
             self._drain_bounce_total += 1
@@ -558,6 +578,8 @@ class Worker:
                 {"worker_id": self.worker_id},
                 trace_id=trace.trace_id,
             )
+            self._emit_span(trace.to_span("worker.serve", self.worker_id,
+                                          attrs={"outcome": "drain_bounce"}))
             return
         if not msg.payload:
             await self._respond_error(msg, "empty payload in ChatModel", trace_id=trace.trace_id)
@@ -651,6 +673,11 @@ class Worker:
         if isinstance(response, dict):
             response.setdefault("stats", {})["trace"] = report
         total_ms = report["spans_ms"].get("total_ms", 0.0)
+        self._emit_span(trace.to_span(
+            "worker.serve", self.worker_id,
+            attrs={"model": model_id, "outcome": "ok",
+                   "role": getattr(self.config, "worker_role", "") or "monolithic"},
+        ))
         if self._slow_request_ms and total_ms > self._slow_request_ms:
             EVENTS.emit(
                 "slow_request",
@@ -682,6 +709,39 @@ class Worker:
             msg, error, data, headers=headers,
             trace_id=trace.trace_id if trace is not None else None,
         )
+        if trace is not None:
+            self._emit_span(trace.to_span(
+                "worker.serve", self.worker_id,
+                attrs={"outcome": "error", "error": error[:160]},
+            ))
+
+    # -- cross-process span emission (obs/aggregator.py consumes) ------------
+
+    def _emit_span(self, span: dict) -> None:
+        """Buffer one span for fire-and-forget batch publish on
+        ``{prefix}.obs.spans``. Spans emitted in the same event-loop tick
+        (serve + kv_pull of one request) coalesce into one message; span
+        loss on a dropped connection is acceptable by design — spans are
+        diagnostics, never load-bearing."""
+        if self.nc is None or not getattr(self.config, "obs_spans", True):
+            return
+        self._span_buf.append(span)
+        self._spans_emitted_total += 1
+        if self._span_flush_task is None or self._span_flush_task.done():
+            self._span_flush_task = asyncio.ensure_future(self._flush_spans())
+
+    async def _flush_spans(self) -> None:
+        await asyncio.sleep(0)  # let same-tick spans join this batch
+        batch, self._span_buf = self._span_buf, []
+        if not batch or self.nc is None:
+            return
+        try:
+            await self.nc.publish(
+                self.config.subject("obs.spans"),
+                json.dumps({"spans": batch}, separators=(",", ":")).encode(),
+            )
+        except (ConnectionError, ValueError):
+            pass  # reconnect in flight; these spans are lost, the next batch isn't
 
     async def _chat_streaming(self, msg: Msg, engine, payload: dict, trace: Trace) -> None:
         assert self.nc is not None
@@ -752,6 +812,10 @@ class Worker:
         if cancelled:
             self._streams_cancelled += 1
             trace.mark("publish")
+            self._emit_span(trace.to_span(
+                "worker.serve", self.worker_id,
+                attrs={"model": model_id, "outcome": "cancelled"},
+            ))
             return
         if final is None:
             # An engine whose stream ends without the terminal chat.completion
@@ -794,65 +858,98 @@ class Worker:
         if not msg.reply:
             return  # nowhere to ship the blob
         t0 = time.monotonic()
+        # span context from the pulling decode worker: the kv_export span
+        # emitted here is the child of its kv_pull span, which is what makes
+        # the two-hop visible in the assembled cluster tree instead of
+        # vanishing from the requesting worker's waterfall
+        hdrs = msg.headers or {}
+        span_parent = parse_span_context(hdrs.get(TRACEPARENT_HEADER))
+        span_trace_id = hdrs.get(TRACE_HEADER) or (
+            span_parent[0] if span_parent else ""
+        )
+        span_t0 = time.time()
+        span_attrs: dict = {"outcome": "error"}
         try:
-            payload = json.loads(msg.payload or b"{}")
-            if not isinstance(payload, dict):
-                raise ValueError("payload must be a JSON object")
-        except ValueError as e:
-            await self._error_terminal(
-                msg, f"invalid JSON in KvExport: {e}", None, True
-            )
-            return
-        model_id = (payload.get("model") or "").strip()
-        if not model_id:
-            await self._error_terminal(
-                msg, "'model' is required in KvExport", None, True
-            )
-            return
-        try:
-            async with _timeout(self.config.kv_transfer_timeout_s):
-                engine = await self.registry.get_engine(model_id)
-                export_fn = getattr(engine, "export_prefix", None)
-                export = (
-                    await export_fn(dict(payload)) if export_fn is not None else None
+            try:
+                payload = json.loads(msg.payload or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("payload must be a JSON object")
+            except ValueError as e:
+                span_attrs["outcome"] = "bad_request"
+                await self._error_terminal(
+                    msg, f"invalid JSON in KvExport: {e}", None, True
                 )
-        except asyncio.TimeoutError:
-            await self._error_terminal(
-                msg, "error in kv export: deadline exceeded",
-                {"model": model_id}, True,
-            )
-            return
-        except (ModelNotFound, EngineError, ValueError, RuntimeError) as e:
-            # ValueError/RuntimeError: the export's internal prefill can hit
-            # the same admission guards as a chat (prompt >= max_seq, pool
-            # exhaustion). A terminal error lets the puller fall back to
-            # local prefill immediately instead of idling out its pull.
-            await self._error_terminal(
-                msg, f"error in kv export: {e}", {"model": model_id}, True
-            )
-            return
-        if export is None or not export.get("chunks"):
-            await self._respond_json(
-                msg, envelope_ok({"no_export": True}),
-                headers={"Nats-Stream-Done": "1"},
-            )
-            return
-        try:
-            blob = encode_kv_blob(export)
-        except KVTransferFormatError as e:
-            await self._error_terminal(
-                msg, f"error in kv export: {e}", {"model": model_id}, True
-            )
-            return
-        digest = hashlib.sha256(blob).hexdigest()
-        meta = {"sha256": digest, "bytes": len(blob),
-                "tokens": len(export["token_ids"])}
-        sent = await self._ship_blob(msg, blob, meta)
-        if sent:
-            self._kv_transfer_bytes["export"] += len(blob)
-            self._kv_transfer_ms["export"] += (time.monotonic() - t0) * 1000.0
-            EVENTS.emit("kv_export", model=model_id, bytes=len(blob),
-                        tokens=meta["tokens"])
+                return
+            model_id = (payload.get("model") or "").strip()
+            if not model_id:
+                span_attrs["outcome"] = "bad_request"
+                await self._error_terminal(
+                    msg, "'model' is required in KvExport", None, True
+                )
+                return
+            span_attrs["model"] = model_id
+            try:
+                async with _timeout(self.config.kv_transfer_timeout_s):
+                    engine = await self.registry.get_engine(model_id)
+                    export_fn = getattr(engine, "export_prefix", None)
+                    export = (
+                        await export_fn(dict(payload)) if export_fn is not None else None
+                    )
+            except asyncio.TimeoutError:
+                span_attrs["outcome"] = "timeout"
+                await self._error_terminal(
+                    msg, "error in kv export: deadline exceeded",
+                    {"model": model_id}, True,
+                )
+                return
+            except (ModelNotFound, EngineError, ValueError, RuntimeError) as e:
+                # ValueError/RuntimeError: the export's internal prefill can hit
+                # the same admission guards as a chat (prompt >= max_seq, pool
+                # exhaustion). A terminal error lets the puller fall back to
+                # local prefill immediately instead of idling out its pull.
+                span_attrs["error"] = str(e)[:160]
+                await self._error_terminal(
+                    msg, f"error in kv export: {e}", {"model": model_id}, True
+                )
+                return
+            if export is None or not export.get("chunks"):
+                span_attrs["outcome"] = "no_export"
+                await self._respond_json(
+                    msg, envelope_ok({"no_export": True}),
+                    headers={"Nats-Stream-Done": "1"},
+                )
+                return
+            try:
+                blob = encode_kv_blob(export)
+            except KVTransferFormatError as e:
+                span_attrs["error"] = str(e)[:160]
+                await self._error_terminal(
+                    msg, f"error in kv export: {e}", {"model": model_id}, True
+                )
+                return
+            digest = hashlib.sha256(blob).hexdigest()
+            meta = {"sha256": digest, "bytes": len(blob),
+                    "tokens": len(export["token_ids"])}
+            sent = await self._ship_blob(msg, blob, meta)
+            if sent:
+                span_attrs.update(outcome="ok", bytes=len(blob),
+                                  tokens=meta["tokens"])
+                self._kv_transfer_bytes["export"] += len(blob)
+                self._kv_transfer_ms["export"] += (time.monotonic() - t0) * 1000.0
+                EVENTS.emit("kv_export", model=model_id, bytes=len(blob),
+                            tokens=meta["tokens"], trace_id=span_trace_id or None)
+        finally:
+            if span_trace_id:
+                self._emit_span(Span(
+                    trace_id=span_trace_id,
+                    span_id=new_span_id(),
+                    stage="worker.kv_export",
+                    worker_id=self.worker_id,
+                    parent_span_id=span_parent[1] if span_parent else "",
+                    t0=span_t0,
+                    t1=time.time(),
+                    attrs=span_attrs,
+                ).to_dict())
 
     async def _ship_blob(self, msg: Msg, blob: bytes, meta: dict) -> bool:
         """Ship an encoded KV blob to ``msg.reply``: Object Store when the
@@ -923,6 +1020,11 @@ class Worker:
         cfg = self.config
         t0 = time.monotonic()
         trace.mark("kv_pull")
+        # the pull is its own span (child of this worker's serve span); its
+        # id travels to the prefill peer in the Traceparent header so the
+        # peer's kv_export span links under it in the assembled tree
+        pull_span_id = new_span_id()
+        pull_t0 = time.time()
         req = {"model": model_id, "messages": payload.get("messages")}
         subject = f"{cfg.subject_prefix}.worker.{peer}.kv_export"
         try:
@@ -933,7 +1035,12 @@ class Worker:
                 json.dumps(req, separators=(",", ":")).encode(),
                 timeout=cfg.kv_transfer_timeout_s,
                 idle_timeout=cfg.kv_transfer_timeout_s,
-                headers={TRACE_HEADER: trace.trace_id},
+                headers={
+                    TRACE_HEADER: trace.trace_id,
+                    TRACEPARENT_HEADER: span_context_value(
+                        trace.trace_id, pull_span_id
+                    ),
+                },
             )
             async for m in stream:
                 if m.headers and "Nats-Stream-Done" in m.headers:
@@ -951,6 +1058,13 @@ class Worker:
                 # graceful skip (peer can't export this prompt) — NOT a
                 # transfer failure; just prefill locally
                 trace.mark("kv_import")
+                self._emit_span(Span(
+                    trace_id=trace.trace_id, span_id=pull_span_id,
+                    stage="worker.kv_pull", worker_id=self.worker_id,
+                    parent_span_id=trace.span_id, t0=pull_t0, t1=time.time(),
+                    attrs={"model": model_id, "peer": peer,
+                           "outcome": "no_export"},
+                ).to_dict())
                 return
             if meta.get("object"):
                 from ..transport.jetstream import ObjectStore
@@ -979,6 +1093,14 @@ class Worker:
                 tokens=(imported or {}).get("tokens", 0),
                 trace_id=trace.trace_id,
             )
+            self._emit_span(Span(
+                trace_id=trace.trace_id, span_id=pull_span_id,
+                stage="worker.kv_pull", worker_id=self.worker_id,
+                parent_span_id=trace.span_id, t0=pull_t0, t1=time.time(),
+                attrs={"model": model_id, "peer": peer, "outcome": "ok",
+                       "bytes": len(blob),
+                       "tokens": (imported or {}).get("tokens", 0)},
+            ).to_dict())
         except Exception as e:  # noqa: BLE001 — transfer failure must never fail the chat
             self._kv_transfer_failures += 1
             self._kv_transfer_ms["import"] += (time.monotonic() - t0) * 1000.0
@@ -986,11 +1108,32 @@ class Worker:
                 "kv prefetch from %s failed (%s: %s); serving with local prefill",
                 peer, type(e).__name__, e,
             )
+            # span context rides the failure event AND the anomaly dump, so
+            # a kv_transfer_failed dump joins the assembled cluster trace by
+            # trace_id (and this pull's exact hop by span_id)
             EVENTS.emit(
                 "kv_transfer_failed", model=model_id, peer=peer,
                 cause=type(e).__name__, error=str(e)[:200],
-                trace_id=trace.trace_id,
+                trace_id=trace.trace_id, span_id=pull_span_id,
+                parent_span_id=trace.span_id,
             )
+            self._emit_span(Span(
+                trace_id=trace.trace_id, span_id=pull_span_id,
+                stage="worker.kv_pull", worker_id=self.worker_id,
+                parent_span_id=trace.span_id, t0=pull_t0, t1=time.time(),
+                attrs={"model": model_id, "peer": peer, "outcome": "failed",
+                       "cause": type(e).__name__},
+            ).to_dict())
+            recorder = getattr(getattr(engine, "batcher", None), "recorder", None)
+            if recorder is not None:
+                recorder.dump(
+                    "kv_transfer_failed",
+                    trace=trace.report(),
+                    extra={"model": model_id, "peer": peer,
+                           "cause": type(e).__name__, "error": str(e)[:200],
+                           "span_id": pull_span_id,
+                           "parent_span_id": trace.span_id},
+                )
 
     async def on_sync_model_from_bucket(self, msg: Msg) -> None:
         """sync_model_from_bucket {object_name, model_id?} — implements the
@@ -1087,6 +1230,8 @@ class Worker:
         r.counter("lmstudio_excluded_bounce_total", self._excluded_bounce_total,
                   help="chat requests bounced retryably because this worker "
                        "appeared in their X-Excluded-Workers header")
+        r.counter("lmstudio_spans_emitted_total", self._spans_emitted_total,
+                  help="trace spans published on the obs.spans subject")
         r.counter("lmstudio_drain_bounce_total", self._drain_bounce_total,
                   help="chat requests bounced retryably while draining")
         r.counter("lmstudio_requests_total", self._requests_total,
@@ -1247,6 +1392,10 @@ class Worker:
         cancels, ring compactions, engine load/evict, slow requests.
         Payload (optional): ``{kind?, limit?}`` filters by event kind and
         caps the reply to the most recent N (default 100)."""
+        if not msg.reply:
+            # fire-and-forget broadcasts land here too (e.g. the aggregator's
+            # slo_burn fan-out on <prefix>.events) — nothing to answer
+            return
         try:
             req = json.loads(msg.payload) if msg.payload and msg.payload.strip() else {}
             if not isinstance(req, dict):

@@ -229,6 +229,15 @@ EXCLUDED_WORKERS_HEADER = "X-Excluded-Workers"
 KV_PREFILL_HEADER = "X-KV-Prefill-Worker"
 
 
+# W3C traceparent-style span context (obs/trace.py): ``00-<trace>-<span>-01``
+# where <span> is the *sender's* span id — the receiving hop records it as
+# parent_span_id on the span it emits to ``{prefix}.obs.spans``, so the
+# fleet aggregator can assemble a causally-correct tree across retries,
+# excluded-worker hops, and the kv_export two-hop. Parsed leniently
+# (obs.trace.parse_span_context): a malformed value is ignored, never fatal.
+TRACEPARENT_HEADER = "Traceparent"
+
+
 # consumer-gone signal for streaming replies: when a streaming consumer
 # abandons its inbox before the terminal Nats-Stream-Done message, the
 # client publishes an empty message to ``<inbox> + STREAM_CANCEL_SUFFIX``.
