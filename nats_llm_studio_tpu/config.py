@@ -310,6 +310,61 @@ class WorkerConfig:
     slo_shed_ratio: float = field(
         default_factory=lambda: float(_env("SLO_SHED_RATIO", "0.05"))
     )
+    # -- elastic autoscaling (serve/autoscaler.py, ISSUE 15) ------------------
+    # embed the autoscaler inside ``route``/``obs`` (the standalone
+    # ``... autoscale`` subcommand always runs one); spawns/drains local
+    # worker subprocesses against the advert + SLO-burn signals
+    obs_autoscale: bool = field(
+        default_factory=lambda: _env("OBS_AUTOSCALE", "0").strip().lower()
+        in ("1", "true", "on")
+    )
+    # fleet bounds: never drain below min, never spawn past max
+    autoscale_min_workers: int = field(
+        default_factory=lambda: int(_env("AUTOSCALE_MIN", "1"))
+    )
+    autoscale_max_workers: int = field(
+        default_factory=lambda: int(_env("AUTOSCALE_MAX", "4"))
+    )
+    # control-loop cadence and hysteresis: pressure (SLO burn, deep queues,
+    # brownout) must persist up_dwell before a spawn; calm must persist
+    # down_dwell before a drain; cooldown blocks back-to-back actions
+    autoscale_interval_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_INTERVAL_S", "1.0"))
+    )
+    autoscale_up_dwell_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_UP_DWELL_S", "2.0"))
+    )
+    autoscale_down_dwell_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_DOWN_DWELL_S", "15.0"))
+    )
+    autoscale_cooldown_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_COOLDOWN_S", "5.0"))
+    )
+    # queue-depth thresholds: mean advert depth at/above up_queue_depth is
+    # pressure; total fleet depth at/below down_queue_depth is idle
+    autoscale_up_queue_depth: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_UP_QUEUE_DEPTH", "8"))
+    )
+    autoscale_down_queue_depth: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_DOWN_QUEUE_DEPTH", "1"))
+    )
+    # spawn supervision: a spawned worker must advertise within grace_s or
+    # it counts as a spawn failure; breaker_failures consecutive failures
+    # open the circuit breaker for breaker_cooldown_s (no spawn storms)
+    autoscale_spawn_grace_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_SPAWN_GRACE_S", "20"))
+    )
+    autoscale_breaker_failures: int = field(
+        default_factory=lambda: int(_env("AUTOSCALE_BREAKER_FAILURES", "3"))
+    )
+    autoscale_breaker_cooldown_s: float = field(
+        default_factory=lambda: float(_env("AUTOSCALE_BREAKER_COOLDOWN_S", "30"))
+    )
+    # hottest prefix-cache paths pushed to a replacement at drain/scale-up
+    # (warm handoff); 0 disables handoff entirely
+    autoscale_handoff_prefixes: int = field(
+        default_factory=lambda: int(_env("AUTOSCALE_HANDOFF_PREFIXES", "4"))
+    )
 
     def __post_init__(self) -> None:
         if self.admit_queue_limit < 0:  # unset: scale with the slot count

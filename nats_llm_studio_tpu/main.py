@@ -10,6 +10,7 @@ fact"). This CLI is that wiring, made first-class:
     python -m nats_llm_studio_tpu route                # standalone cluster router
     python -m nats_llm_studio_tpu gateway [--port 8080]  # OpenAI-compatible HTTP front door
     python -m nats_llm_studio_tpu obs                  # fleet metrics/trace aggregator
+    python -m nats_llm_studio_tpu autoscale            # elastic worker autoscaler
     python -m nats_llm_studio_tpu publish <model.gguf> <publisher>/<name>
     python -m nats_llm_studio_tpu chat <model_id> "prompt..."
 
@@ -158,6 +159,13 @@ async def _run_route(args: argparse.Namespace) -> None:
         retry=RetryPolicy(max_attempts=args.max_attempts, retry_on_timeout=True),
     )
     await proc.start()
+    scaler = None
+    if cfg.obs_autoscale:
+        # OBS_AUTOSCALE=1 embeds the elastic control loop in the router
+        # process (serve/autoscaler.py); it shares the connection
+        from .serve import Autoscaler
+
+        scaler = Autoscaler.from_config(nc, cfg)
     agg = None
     if cfg.obs_aggregator:
         # OBS_AGGREGATOR=1 embeds the fleet collector in the router process
@@ -173,15 +181,24 @@ async def _run_route(args: argparse.Namespace) -> None:
             slo_window_s=cfg.slo_window_s,
             slo_served_ratio=cfg.slo_served_ratio,
             slo_shed_ratio=cfg.slo_shed_ratio,
+            # a co-tenant autoscaler's families ride the cluster exposition
+            extra_expositions=(
+                [scaler.render_prometheus] if scaler is not None else None
+            ),
         )
         await agg.start()
-    log.info("router on %s (prefix %s%s)", cfg.nats_url, cfg.subject_prefix,
-             ", embedded aggregator" if agg is not None else "")
+    if scaler is not None:
+        await scaler.start()
+    log.info("router on %s (prefix %s%s%s)", cfg.nats_url, cfg.subject_prefix,
+             ", embedded aggregator" if agg is not None else "",
+             ", embedded autoscaler" if scaler is not None else "")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if scaler is not None:
+        await scaler.stop()
     if agg is not None:
         await agg.stop()
     await proc.stop()
@@ -199,6 +216,11 @@ async def _run_obs(args: argparse.Namespace) -> None:
 
     cfg = WorkerConfig()
     nc = await connect(cfg.nats_url, name="tpu-obs")
+    scaler = None
+    if cfg.obs_autoscale:
+        from .serve import Autoscaler
+
+        scaler = Autoscaler.from_config(nc, cfg)
     agg = Aggregator(
         nc,
         prefix=cfg.subject_prefix,
@@ -208,16 +230,68 @@ async def _run_obs(args: argparse.Namespace) -> None:
         slo_window_s=cfg.slo_window_s,
         slo_served_ratio=cfg.slo_served_ratio,
         slo_shed_ratio=cfg.slo_shed_ratio,
+        extra_expositions=(
+            [scaler.render_prometheus] if scaler is not None else None
+        ),
     )
     await agg.start()
-    log.info("aggregator on %s (prefix %s, scrape %.1fs)",
-             cfg.nats_url, cfg.subject_prefix, cfg.obs_scrape_interval_s)
+    if scaler is not None:
+        await scaler.start()
+    log.info("aggregator on %s (prefix %s, scrape %.1fs%s)",
+             cfg.nats_url, cfg.subject_prefix, cfg.obs_scrape_interval_s,
+             ", embedded autoscaler" if scaler is not None else "")
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if scaler is not None:
+        await scaler.stop()
     await agg.stop()
+    await nc.close()
+
+
+async def _run_autoscale(args: argparse.Namespace) -> None:
+    """Standalone elastic autoscaler (serve/autoscaler.py): watches worker
+    adverts and slo_burn events, spawns/drains local worker subprocesses
+    within [AUTOSCALE_MIN, AUTOSCALE_MAX], and serves its decision counters
+    on ``{prefix}.autoscale.metrics.prom``. OBS_AGGREGATOR=1 co-hosts the
+    fleet collector so one process is a complete control plane."""
+    from .serve import Autoscaler
+    from .transport import connect
+
+    cfg = WorkerConfig()
+    nc = await connect(cfg.nats_url, name="tpu-autoscaler")
+    scaler = Autoscaler.from_config(nc, cfg)
+    agg = None
+    if cfg.obs_aggregator:
+        from .obs import Aggregator
+
+        agg = Aggregator(
+            nc,
+            prefix=cfg.subject_prefix,
+            scrape_interval_s=cfg.obs_scrape_interval_s,
+            stale_after_s=cfg.router_stale_after_s,
+            slo_ttft_p95_ms=cfg.slo_ttft_p95_ms,
+            slo_window_s=cfg.slo_window_s,
+            slo_served_ratio=cfg.slo_served_ratio,
+            slo_shed_ratio=cfg.slo_shed_ratio,
+            extra_expositions=[scaler.render_prometheus],
+        )
+        await agg.start()
+    await scaler.start()
+    log.info("autoscaler on %s (prefix %s, bounds [%d, %d]%s)",
+             cfg.nats_url, cfg.subject_prefix, scaler.min_workers,
+             scaler.max_workers,
+             ", embedded aggregator" if agg is not None else "")
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await scaler.stop()
+    if agg is not None:
+        await agg.stop()
     await nc.close()
 
 
@@ -323,6 +397,8 @@ def main(argv: list[str] | None = None) -> None:
 
     sub.add_parser("obs", help="run the fleet metrics/trace aggregator")
 
+    sub.add_parser("autoscale", help="run the elastic worker autoscaler")
+
     gw = sub.add_parser("gateway", help="run the OpenAI-compatible HTTP gateway")
     gw.add_argument("--host", default=None)
     gw.add_argument("--port", type=int, default=None)
@@ -346,6 +422,7 @@ def main(argv: list[str] | None = None) -> None:
         "route": _run_route,
         "gateway": _run_gateway,
         "obs": _run_obs,
+        "autoscale": _run_autoscale,
         "publish": _run_publish,
         "chat": _run_chat,
     }[args.cmd]

@@ -438,9 +438,14 @@ class Aggregator:
                  scrape_timeout_s: float | None = None,
                  slo: SloEvaluator | None = None,
                  slo_ttft_p95_ms: float = 2000.0, slo_window_s: float = 60.0,
-                 slo_served_ratio: float = 0.99, slo_shed_ratio: float = 0.05):
+                 slo_served_ratio: float = 0.99, slo_shed_ratio: float = 0.05,
+                 extra_expositions: list | None = None):
         self.nc = nc
         self.prefix = prefix
+        # zero-arg callables, each returning exposition text merged into
+        # render_cluster() — how an embedded autoscaler's families ride the
+        # cluster scrape without a second process (ISSUE 15)
+        self.extra_expositions = list(extra_expositions or [])
         self.scrape_interval_s = scrape_interval_s
         self.stale_after_s = stale_after_s
         self.scrape_timeout_s = (scrape_timeout_s if scrape_timeout_s is not None
@@ -578,7 +583,13 @@ class Aggregator:
         worker_id label) plus the aggregator's own lmstudio_cluster_*
         families."""
         r = PromRenderer()
-        merge_into(r, [self._last_texts[w] for w in sorted(self._last_texts)])
+        texts = [self._last_texts[w] for w in sorted(self._last_texts)]
+        for fn in self.extra_expositions:
+            try:
+                texts.append(fn())
+            except Exception:  # noqa: BLE001 — a co-tenant must not break the scrape
+                log.exception("extra exposition source failed")
+        merge_into(r, texts)
         r.gauge("lmstudio_cluster_workers", len(self.live_workers()),
                 help="workers advertising within the staleness window")
         r.counter("lmstudio_cluster_scrapes_total", self.scrapes_total,

@@ -1,4 +1,5 @@
 from .api import ChatEngine, EngineError, ModelNotFound, Registry
+from .autoscaler import Autoscaler
 from .router import (
     ClusterRouter,
     RouterExhausted,
@@ -9,6 +10,7 @@ from .router import (
 from .worker import Worker
 
 __all__ = [
+    "Autoscaler",
     "ChatEngine",
     "ClusterRouter",
     "EngineError",

@@ -368,6 +368,35 @@ class PrefixCache:
         with self._lock:
             return self._evict_to_locked(0)
 
+    def hot_prefixes(self, limit: int = 4) -> list[list[int]]:
+        """The hottest cached prefix paths, most-recently-used first: each
+        entry is the full token-id list root→leaf (concatenated chunk keys),
+        exactly the shape ``export_prefix_blocks`` takes. A draining worker
+        enumerates these to warm-hand its cache to a replacement (ISSUE 15);
+        enumeration does not pin, touch ticks, or count as hits — handoff
+        must not perturb the LRU it is reading."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            leaves: list[_Node] = []
+            stack = list(self._root.values())
+            while stack:
+                nd = stack.pop()
+                if nd.children:
+                    stack.extend(nd.children.values())
+                else:
+                    leaves.append(nd)
+            leaves.sort(key=lambda nd: nd.tick, reverse=True)
+            out: list[list[int]] = []
+            for leaf in leaves[:limit]:
+                chain = []
+                nd = leaf
+                while nd is not None:
+                    chain.append(nd.key)
+                    nd = nd.parent
+                out.append([t for key in reversed(chain) for t in key])
+            return out
+
     # -- introspection --------------------------------------------------------
 
     @property

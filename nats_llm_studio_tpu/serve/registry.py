@@ -165,6 +165,24 @@ def _brownout_env(enabled: bool | None = None) -> BrownoutConfig | None:
     )
 
 
+def _pull_precompile_env(default: bool = True) -> bool:
+    v = os.environ.get("PULL_PRECOMPILE", "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off")
+
+
+def _compile_cache_dir_configured() -> bool:
+    """Whether a persistent XLA compile cache is active in this process
+    (WorkerConfig.configure_jax or the JAX env knob). Pull-time precompile
+    only pays off when the compiled grid lands somewhere a replacement
+    worker can replay it from."""
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:
+        return False
+
+
 def _deadline_min_tokens_env(default: int = 1) -> int:
     """Feasibility floor for deadline-aware admission: a request that cannot
     deliver this many tokens before its deadline skips prefill and is shed
@@ -611,6 +629,7 @@ class LocalRegistry(Registry):
         obs_recorder_interval_ms: float | None = None,
         obs_dump_dir: str | None = None,
         worker_id: str = "",
+        pull_precompile: bool | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -730,6 +749,21 @@ class LocalRegistry(Registry):
         self.recorder_counters: dict[str, Any] = {
             "engine_restarts": lambda: self.engine_restarts_total,
         }
+        # pull-time precompile (ISSUE 15): at pull_model, compile the full
+        # jit grid into the persistent compile cache so a replacement
+        # worker's first request replays warm compiles. Only active when a
+        # compile cache dir is configured — warming a process-local cache
+        # would just tax the pull. None = read PULL_PRECOMPILE (default on).
+        self.pull_precompile = (
+            pull_precompile
+            if pull_precompile is not None
+            else _pull_precompile_env()
+        )
+        # elastic-drain flag (serve/worker.py begin_drain → set_draining):
+        # while set, restart_engine refuses to relaunch engines — a worker
+        # being scaled down must never be resurrected mid-teardown, even by
+        # a supervisor restart already sleeping out its backoff
+        self.draining = False
 
     # -- Registry ------------------------------------------------------------
 
@@ -770,7 +804,55 @@ class LocalRegistry(Registry):
                 f"pulled {identifier}, but it is {reason} — retry on "
                 f"another worker"
             )
+        if self.pull_precompile and _compile_cache_dir_configured():
+            # reported via the pull_precompile event and the log, NOT the
+            # transcript: the reply text is wire contract ("pulled")
+            await self._precompile(identifier)
         return transcript
+
+    async def _precompile(self, model_id: str) -> int:
+        """Best-effort jit-grid warm at pull time: load the engine and
+        compile every chunk/full-prefill program, populating the persistent
+        compile cache a seconds-cold replacement worker will replay
+        (PR 6/7's lmstudio_compile_cache_* counters measure the replay).
+        Never fails the pull — the model IS pulled; precompile is a
+        cold-start optimization. The engine load serves only the compile:
+        when the model was not already resident it is unloaded again on the
+        way out, so pull leaves it cached-not-loaded (the programs persist
+        on disk either way)."""
+        was_loaded = model_id in self._engines
+        try:
+            eng = await self.get_engine(model_id)
+        except (EngineError, ModelNotFound) as e:
+            log.warning("pull precompile skipped for %s: %s", model_id, e)
+            return 0
+        n = 0
+        try:
+            warm = getattr(
+                getattr(eng, "batcher", None), "warm_chunk_programs", None
+            )
+            if warm is None:
+                return 0
+            t0 = time.perf_counter()
+            try:
+                n = await asyncio.to_thread(warm)
+            except Exception as e:  # noqa: BLE001 — precompile is best-effort
+                log.warning("pull precompile failed for %s: %s", model_id, e)
+                return 0
+            obs_emit("pull_precompile", model=model_id, programs=n,
+                     seconds=round(time.perf_counter() - t0, 2))
+            log.info("pull precompile: %d programs for %s in %.2fs",
+                     n, model_id, time.perf_counter() - t0)
+            return n
+        finally:
+            if not was_loaded and self._engines.get(model_id) is eng:
+                self._engines.pop(model_id, None)
+                self._hbm_committed.pop(model_id, None)
+                self._prefix_bytes.pop(model_id, None)
+                self._last_used.pop(model_id, None)
+                await eng.unload()
+                obs_emit("engine_unload", model=model_id,
+                         reason="pull_precompile")
 
     async def delete(self, model_id: str) -> str:
         eng = self._engines.pop(model_id, None)
@@ -1157,6 +1239,8 @@ class LocalRegistry(Registry):
         many crashes inside the window — refuse-until-reset), or "gone" (the
         engine was already unloaded by a concurrent delete/evict). A reload
         failure propagates as EngineError after the teardown."""
+        if self.draining:
+            return "draining"
         t0 = time.monotonic()
         async with self._load_lock:
             eng = self._engines.pop(model_id, None)
@@ -1201,6 +1285,10 @@ class LocalRegistry(Registry):
         # backoff + reload OUTSIDE the load lock: a long XLA reload must not
         # block unrelated loads, and get_engine takes the lock itself
         await asyncio.sleep(backoff)
+        if self.draining:
+            # the drain began while we slept out the backoff — a worker
+            # being scaled down must not resurrect its engine mid-teardown
+            return "draining"
         await self.get_engine(model_id)
         self.engine_restarts_total += 1
         latency_ms = (time.monotonic() - t0) * 1e3
@@ -1242,6 +1330,12 @@ class LocalRegistry(Registry):
             if mesh_shape:
                 out[mid]["mesh"] = mesh_shape
         return out
+
+    def set_draining(self, flag: bool = True) -> None:
+        """Raise (or clear) the elastic-drain flag: while set,
+        ``restart_engine`` refuses to relaunch engines, so a supervisor
+        restart racing a scale-down drain cannot resurrect the worker."""
+        self.draining = bool(flag)
 
     def poisoned_models(self) -> dict[str, str]:
         return dict(self._poisoned)

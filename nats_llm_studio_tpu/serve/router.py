@@ -61,6 +61,14 @@ log = logging.getLogger(__name__)
 ADVERT_SUBJECT = "cluster.adverts"  # published under the subject prefix
 ROUTE_SUBJECT = "route.chat_model"  # RouterProcess's forwarding subject
 DEFAULT_HEAD_CHARS = 256
+# seq-ordering guard bounds (ingest): a backward seq step within
+# SEQ_REORDER_WINDOW is a stale/reordered packet and is dropped; a jump
+# further back than that — or an advert numbered within SEQ_RESTART_MAX
+# while we hold a higher seq — is a RESPAWNED worker whose counter
+# restarted at 1, and must replace the dead incarnation's advert NOW
+# instead of being ignored until staleness ages it out (ISSUE 15).
+SEQ_REORDER_WINDOW = 64
+SEQ_RESTART_MAX = 3
 
 
 class RouterExhausted(asyncio.TimeoutError):
@@ -254,13 +262,20 @@ class ClusterRouter:
     def ingest(self, d: dict) -> None:
         """Feed one advert dict (the sub callback does this; tests and the
         bench can inject directly). Out-of-order adverts from one worker are
-        dropped by seq."""
+        dropped by seq — but a drained-then-respawned worker reusing the
+        same WORKER_ID restarts its counter at 1, and its fresh adverts must
+        not be mistaken for reorders of the dead incarnation's stream."""
         adv = WorkerAdvert.from_dict(d)
         if adv is None:
             return
         cur = self._members.get(adv.worker_id)
         if cur is not None and adv.seq and adv.seq < cur.seq:
-            return
+            restarted = (
+                adv.seq <= SEQ_RESTART_MAX
+                or cur.seq - adv.seq > SEQ_REORDER_WINDOW
+            )
+            if not restarted:
+                return
         self._members[adv.worker_id] = adv
 
     def mark_dead(self, worker_id: str) -> None:

@@ -64,6 +64,11 @@ from .router import ADVERT_SUBJECT, RecentHeads, prompt_head_hash
 
 log = logging.getLogger(__name__)
 
+# model id accompanying a raw KVX1 blob pushed at a peer's kv_import
+# subject (warm prefix-cache handoff, ISSUE 15); the Object Store
+# reference form carries the model inside its JSON body instead
+KV_MODEL_HEADER = "X-KV-Model"
+
 
 if hasattr(asyncio, "timeout"):
     _timeout = asyncio.timeout  # Python >= 3.11
@@ -124,6 +129,11 @@ class Worker:
         self._kv_transfer_bytes = {"export": 0, "import": 0}
         self._kv_transfer_ms = {"export": 0.0, "import": 0.0}
         self._kv_transfer_failures = 0  # pulls that fell back to local prefill
+        # -- warm prefix-cache handoff (ISSUE 15) ----------------------------
+        # hot prefixes pushed to a replacement worker at drain/scale-up, and
+        # prefixes received+imported from a draining donor
+        self._warm_handoff_sent = 0
+        self._warm_handoff_received = 0
         # chat requests slower than this end-to-end land in the event ring
         # for post-hoc diagnosis (0 disables)
         self._slow_request_ms = float(
@@ -197,6 +207,12 @@ class Worker:
             # an engine that cannot export replies no_export gracefully, so
             # a stale role map degrades to local prefill instead of timeout
             ("kv_export", self.on_kv_export),
+            # warm prefix-cache handoff (ISSUE 15): kv_import receives a
+            # pushed KVX1 blob (or an Object Store reference) from a
+            # draining donor; kv_handoff tells THIS worker to push its
+            # hottest prefixes to a named recipient (autoscaler control)
+            ("kv_import", self.on_kv_import),
+            ("kv_handoff", self.on_kv_handoff),
         ):
             await self.nc.subscribe(f"{wid_prefix}.{op}", cb=self._guarded(handler))
         # drain control: broadcast subject, each worker matches on payload
@@ -308,25 +324,39 @@ class Worker:
         except (TypeError, ValueError):
             await self._respond_error(msg, "'deadline_s' must be a number")
             return
-        result = await self.begin_drain(deadline_s)
+        handoff_to = (req.get("handoff_to") or "").strip() or None
+        result = await self.begin_drain(deadline_s, handoff_to=handoff_to)
         await self._respond_ok(msg, result)
 
-    async def begin_drain(self, deadline_s: float | None = None) -> dict:
+    async def begin_drain(
+        self, deadline_s: float | None = None, handoff_to: str | None = None
+    ) -> dict:
         """Graceful handoff: stop accepting new queue-group work (drop the
         queue subs — the broker routes around us immediately), advertise the
         draining flag, let in-flight decode finish up to the drain deadline,
         then stop the batchers — which fail the remainder with the existing
         retryable "worker draining, retry on another worker" envelope so the
         client RetryPolicy lands them on a peer. Directed/control subjects
-        stay up: a draining worker still answers health and bounces chat."""
+        stay up: a draining worker still answers health and bounces chat.
+
+        With ``handoff_to`` (ISSUE 15), the hottest prefix-cache block sets
+        are pushed to the named replacement worker after in-flight work
+        settles and before the batchers stop — so the replacement starts
+        with a hit rate instead of a cold cache."""
         if deadline_s is None:
             deadline_s = self.config.drain_deadline_s
         if self.draining:
             return {"worker_id": self.worker_id, "draining": True,
                     "already_draining": True}
         self.draining = True
+        # suppress the registry's engine-restart path for the whole
+        # teardown: a supervisor restart already sleeping out its backoff
+        # must not resurrect an engine we are about to stop
+        set_drain = getattr(self.registry, "set_draining", None)
+        if set_drain is not None:
+            set_drain(True)
         EVENTS.emit("worker_drain", worker_id=self.worker_id,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, handoff_to=handoff_to or "")
         log.info("worker %s draining (deadline %.1fs)", self.worker_id, deadline_s)
         for sub in self._queue_subs:
             await sub.unsubscribe()
@@ -350,6 +380,13 @@ class Worker:
                 )
                 break
             await asyncio.sleep(0.05)
+        handoff: dict | None = None
+        if handoff_to and handoff_to != self.worker_id:
+            # after the busy-wait, before the batcher stops: the cache
+            # blocks must still be alive to export. Best-effort — a failed
+            # handoff degrades the replacement to a cold cache, never
+            # blocks the drain.
+            handoff = await self.push_warm_handoff(handoff_to)
         stopped = []
         for mid, eng in list(self.registry.loaded_engines().items()):
             b = getattr(eng, "batcher", None)
@@ -360,13 +397,16 @@ class Worker:
                 await asyncio.to_thread(b.stop)
                 stopped.append(mid)
         await self._publish_advert()
-        return {
+        result = {
             "worker_id": self.worker_id,
             "draining": True,
             "finished_in_time": finished_in_time,
             "stopped_engines": stopped,
             "deadline_s": deadline_s,
         }
+        if handoff is not None:
+            result["handoff"] = handoff
+        return result
 
     async def _supervise(self) -> None:
         """Engine watchdog: every ``supervise_interval_s`` check each loaded
@@ -1135,6 +1175,214 @@ class Worker:
                            "parent_span_id": trace.span_id},
                 )
 
+    # -- warm prefix-cache handoff (ISSUE 15 tentpole) -----------------------
+
+    async def push_warm_handoff(
+        self, recipient: str, limit: int | None = None
+    ) -> dict:
+        """Push this worker's hottest prefix-cache block sets to
+        ``recipient``'s directed ``kv_import`` subject so it starts serving
+        with a hit rate instead of a cold cache. Used by a draining worker
+        handing off to its replacement, and by the autoscaler to warm a
+        fresh spawn from the best live peer. Best-effort throughout: every
+        failed prefix is counted and skipped, never raised — a botched
+        handoff degrades the recipient to a cold cache, nothing worse."""
+        assert self.nc is not None
+        cfg = self.config
+        if limit is None:
+            limit = int(getattr(cfg, "autoscale_handoff_prefixes", 4) or 0)
+        if limit <= 0 or recipient == self.worker_id:
+            return {"to": recipient, "sent": 0, "failed": 0, "tokens": 0}
+        subject = f"{cfg.subject_prefix}.worker.{recipient}.kv_import"
+        sent = failed = tokens = 0
+        for mid, eng in list(self.registry.loaded_engines().items()):
+            b = getattr(eng, "batcher", None)
+            pc = getattr(b, "prefix_cache", None)
+            export_fn = getattr(b, "export_prefix_blocks", None)
+            hot_fn = getattr(pc, "hot_prefixes", None)
+            if b is None or hot_fn is None or export_fn is None:
+                continue  # fake/test engine or dense-only batcher: nothing to hand
+            for path in hot_fn(limit):
+                t0 = time.monotonic()
+                try:
+                    export = await asyncio.to_thread(export_fn, path)
+                    if not export or not export.get("chunks"):
+                        continue  # evicted between enumeration and gather
+                    blob = encode_kv_blob(export)
+                    ok = await self._push_kv_blob(subject, mid, blob)
+                except Exception as e:  # noqa: BLE001 — handoff must not block the drain
+                    log.warning("warm handoff of a %s prefix to %s failed: %s",
+                                mid, recipient, e)
+                    failed += 1
+                    continue
+                if ok:
+                    sent += 1
+                    tokens += len(export["token_ids"])
+                    self._warm_handoff_sent += 1
+                    self._kv_transfer_bytes["export"] += len(blob)
+                    self._kv_transfer_ms["export"] += (
+                        time.monotonic() - t0
+                    ) * 1000.0
+                else:
+                    failed += 1
+        EVENTS.emit("warm_handoff", worker_id=self.worker_id, to=recipient,
+                    sent=sent, failed=failed, tokens=tokens)
+        log.info("worker %s warm handoff to %s: %d prefixes (%d tokens), "
+                 "%d failed", self.worker_id, recipient, sent, tokens, failed)
+        return {"to": recipient, "sent": sent, "failed": failed,
+                "tokens": tokens}
+
+    async def _push_kv_blob(
+        self, subject: str, model_id: str, blob: bytes
+    ) -> bool:
+        """One encoded blob to a peer's kv_import: a raw request when it
+        fits under the broker frame limit (and the Object Store threshold),
+        a JetStream Object Store reference otherwise. True when the peer
+        confirms the import."""
+        assert self.nc is not None
+        cfg = self.config
+        digest = hashlib.sha256(blob).hexdigest()
+        objstore_min = int(getattr(cfg, "kv_transfer_objstore_bytes", 0) or 0)
+        frame = (getattr(self.nc, "server_info", None) or {}).get("max_payload")
+        inline_max = max(1, int(frame) - 1024) if frame else None
+        via_objstore = (objstore_min > 0 and len(blob) >= objstore_min) or (
+            inline_max is not None and len(blob) > inline_max
+        )
+        if via_objstore:
+            from ..transport.jetstream import ObjectStore
+
+            bucket = "kv-transfer"
+            obj = f"{self.worker_id}-handoff-{digest[:16]}"
+            store = ObjectStore(self.nc, timeout=cfg.kv_transfer_timeout_s)
+            await store.ensure_bucket(bucket)
+            await store.put(bucket, obj, blob)
+            ref = {"model": model_id, "bucket": bucket, "object": obj,
+                   "sha256": digest, "bytes": len(blob)}
+            reply = await self.nc.request(
+                subject, json.dumps(ref, separators=(",", ":")).encode(),
+                timeout=cfg.kv_transfer_timeout_s,
+            )
+        else:
+            reply = await self.nc.request(
+                subject, blob, timeout=cfg.kv_transfer_timeout_s,
+                headers={KV_MODEL_HEADER: model_id},
+            )
+        env = json.loads(reply.payload or b"{}")
+        return bool(env.get("ok")) and bool(
+            (env.get("data") or {}).get("imported")
+        )
+
+    async def on_kv_import(self, msg: Msg) -> None:
+        """kv_import — directed subject ``{prefix}.worker.<id>.kv_import``:
+        a draining donor (or the autoscaler's chosen peer) PUSHES a hot
+        prefix here. The payload is either the raw KVX1 blob with the model
+        id in the ``X-KV-Model`` header, or a JSON Object Store reference
+        ``{model, bucket, object, sha256, bytes}`` for blobs over the
+        threshold. The blocks land in the local pool + radix cache so the
+        next matching prompt admits as a prefix hit. An engine that cannot
+        import (fake/test engine) replies ``{imported: false}`` — a graceful
+        no-op, never an error."""
+        self._requests_total += 1
+        payload = msg.payload or b""
+        t0 = time.monotonic()
+        try:
+            if payload[:4] == b"KVX1":
+                model_id = (
+                    (msg.headers or {}).get(KV_MODEL_HEADER) or ""
+                ).strip()
+                if not model_id:
+                    await self._respond_error(
+                        msg,
+                        f"'{KV_MODEL_HEADER}' header is required with a raw "
+                        f"KV blob",
+                    )
+                    return
+                blob = payload
+            else:
+                try:
+                    ref = json.loads(payload or b"{}")
+                    if not isinstance(ref, dict):
+                        raise ValueError("payload must be a JSON object")
+                except ValueError as e:
+                    await self._respond_error(
+                        msg, f"invalid JSON in KvImport: {e}"
+                    )
+                    return
+                model_id = (ref.get("model") or "").strip()
+                if not model_id or not ref.get("object"):
+                    await self._respond_error(
+                        msg, "'model' and 'object' are required in KvImport"
+                    )
+                    return
+                from ..transport.jetstream import ObjectStore
+
+                assert self.nc is not None
+                store = ObjectStore(
+                    self.nc, timeout=self.config.kv_transfer_timeout_s
+                )
+                blob = await store.get(ref["bucket"], ref["object"])
+                # best-effort cleanup: the blob is single-use
+                with contextlib.suppress(Exception):
+                    await store.delete(ref["bucket"], ref["object"])
+                if len(blob) != int(ref.get("bytes", -1)) or (
+                    hashlib.sha256(blob).hexdigest() != ref.get("sha256")
+                ):
+                    raise KVTransferFormatError(
+                        "handoff blob failed integrity check"
+                    )
+            export = decode_kv_blob(blob)
+            engine = await self.registry.get_engine(model_id)
+            import_fn = getattr(engine, "import_prefix", None)
+            if import_fn is None:
+                await self._respond_ok(
+                    msg, {"imported": False, "reason": "no_import"}
+                )
+                return
+            imported = await import_fn(export)
+            self._warm_handoff_received += 1
+            self._kv_transfer_bytes["import"] += len(blob)
+            self._kv_transfer_ms["import"] += (time.monotonic() - t0) * 1000.0
+            EVENTS.emit("warm_handoff_import", model=model_id, bytes=len(blob),
+                        tokens=(imported or {}).get("tokens", 0))
+            await self._respond_ok(msg, {
+                "imported": True, "model": model_id,
+                "tokens": (imported or {}).get("tokens", 0),
+            })
+        except (ModelNotFound, EngineError, KVTransferFormatError,
+                ValueError, RuntimeError) as e:
+            self._kv_transfer_failures += 1
+            await self._respond_error(msg, f"error in kv import: {e}")
+
+    async def on_kv_handoff(self, msg: Msg) -> None:
+        """kv_handoff — control subject ``{prefix}.worker.<id>.kv_handoff``:
+        ``{"to": worker_id, "limit"?}`` makes THIS worker push its hottest
+        cached prefixes to the named peer. The autoscaler uses it to warm a
+        freshly spawned worker from the best live donor without waiting for
+        anyone to drain."""
+        self._requests_total += 1
+        try:
+            req = json.loads(msg.payload or b"{}")
+            if not isinstance(req, dict):
+                raise ValueError("payload must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(msg, f"invalid JSON in KvHandoff: {e}")
+            return
+        to = (req.get("to") or "").strip()
+        if not to:
+            await self._respond_error(msg, "'to' is required in KvHandoff")
+            return
+        if to == self.worker_id:
+            await self._respond_error(msg, "cannot hand off to self")
+            return
+        limit = req.get("limit")
+        try:
+            limit = int(limit) if limit is not None else None
+        except (TypeError, ValueError):
+            await self._respond_error(msg, "'limit' must be an integer")
+            return
+        result = await self.push_warm_handoff(to, limit=limit)
+        await self._respond_ok(msg, result)
+
     async def on_sync_model_from_bucket(self, msg: Msg) -> None:
         """sync_model_from_bucket {object_name, model_id?} — implements the
         README-only conceptual subject (/root/reference/README.md:286-318):
@@ -1262,6 +1510,14 @@ class Worker:
                   self._kv_transfer_failures,
                   help="KV pulls that failed (timeout, corrupt blob, pool "
                        "exhaustion) and fell back to local prefill")
+        r.counter("lmstudio_warm_handoff_sent_total",
+                  self._warm_handoff_sent,
+                  help="hot prefix-cache block sets pushed to a replacement "
+                       "worker (drain handoff or autoscaler warm-up)")
+        r.counter("lmstudio_warm_handoff_received_total",
+                  self._warm_handoff_received,
+                  help="hot prefix-cache block sets imported from a donor "
+                       "worker at kv_import")
         reg = self.registry.stats()
         for key in ("models_cached", "models_loaded", "engine_requests",
                     "hbm_committed_bytes"):

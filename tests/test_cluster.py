@@ -144,6 +144,19 @@ def test_router_pick_ranking_staleness_and_mark_dead():
     r.ingest({"worker_id": "w-b", "queue_depth": 99, "models": [], "seq": 9})
     assert r._members["w-b"].queue_depth == 1
 
+    # ...but a respawned worker reusing the id (its seq restarted near
+    # zero) must not be ignored until the stale window ages the ghost out
+    # (ISSUE 15): seq <= SEQ_RESTART_MAX is accepted as a restart
+    r.ingest({"worker_id": "w-b", "queue_depth": 3, "models": ["m"], "seq": 2})
+    assert r._members["w-b"].queue_depth == 3
+    # as is a backward jump beyond the reorder window; a small backward
+    # step inside it is still just a late packet
+    r.ingest({"worker_id": "w-b", "queue_depth": 1, "models": ["m"], "seq": 500})
+    r.ingest({"worker_id": "w-b", "queue_depth": 99, "models": ["m"], "seq": 460})
+    assert r._members["w-b"].queue_depth == 1  # within window: stale, dropped
+    r.ingest({"worker_id": "w-b", "queue_depth": 7, "models": ["m"], "seq": 100})
+    assert r._members["w-b"].queue_depth == 7  # beyond window: a restart
+
     # mark_dead drops the member NOW
     r.mark_dead("w-b")
     assert r.pick(model="m", messages=msgs) == "w-a"
