@@ -1816,6 +1816,139 @@ def obs_overhead_bench(cfg, params, *, seq: int | None = None,
     return out
 
 
+def efficiency_bench(cfg, params, *, seq: int | None = None,
+                     slots: int | None = None, n_reqs: int | None = None,
+                     max_new: int | None = None) -> dict:
+    """Compute-efficiency plane (obs/roofline.py) under the standard
+    overload mix: served requests, client cancels mid-stream, and tight
+    deadlines. Asserts the roofline gauges report nonzero MFU and MBU for
+    BOTH prefill and decode program classes, and that the device-time
+    ledger's category sums reconcile with the batcher's measured dispatch
+    wall time to within 10% — every device-ms is attributed somewhere.
+    Reports MFU/MBU, the waste breakdown as a percentage of device time,
+    and goodput (served tokens per attributed device-second)."""
+    import asyncio
+
+    from nats_llm_studio_tpu.engine.generator import SamplingParams
+    from nats_llm_studio_tpu.obs.roofline import chip_peaks
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+
+    seq = seq or int(os.environ.get("BENCH_EFF_SEQ", "256"))
+    slots = slots or int(os.environ.get("BENCH_EFF_SLOTS", "4"))
+    n_reqs = n_reqs or int(os.environ.get("BENCH_EFF_REQS", "9"))
+    max_new = max_new or int(os.environ.get("BENCH_EFF_NEW", "32"))
+    prompt_len = max(4, min(32, seq // 4))
+    buckets = [b for b in (64, 128, 256) if b < seq] + [seq]
+
+    batcher = ContinuousBatcher(params, cfg, max_slots=slots,
+                                max_seq_len=seq, buckets=buckets)
+
+    async def drive() -> dict:
+        sp = SamplingParams(temperature=0.0, max_tokens=max_new)
+
+        def prompt_for(i: int) -> list[int]:
+            return [(i * 31 + j) % 97 + 1 for j in range(prompt_len)]
+
+        async def served(i: int) -> int:
+            return len([t async for t in batcher.submit(prompt_for(i), sp)])
+
+        async def cancelled(i: int) -> int:
+            # client disconnect after 2 tokens: GeneratorExit -> cancel ->
+            # the slot's accrued device-ms lands in the cancelled category
+            agen = batcher.submit_batched(prompt_for(i), sp)
+            got = 0
+            async for batch in agen:
+                got += len(batch)
+                if got >= 2:
+                    break
+            await agen.aclose()
+            return got
+
+        async def tight_deadline(i: int) -> int:
+            # a deadline the decode cannot finish inside: either sheds
+            # pre-prefill (no device time, no category) or aborts
+            # mid-decode (deadline_abort gets the accrued ms) — both are
+            # honest outcomes; the reconciliation below must hold either way
+            got = 0
+            try:
+                async for t in batcher.submit(
+                    prompt_for(i), sp, deadline=time.monotonic() + 0.25
+                ):
+                    got += 1
+            except Exception:  # noqa: BLE001 — shed/abort envelopes expected
+                pass
+            return got
+
+        kinds = (served, cancelled, tight_deadline)
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *[kinds[i % len(kinds)](i) for i in range(n_reqs)],
+            return_exceptions=True,
+        )
+        wall_s = time.perf_counter() - t0
+        # read the gauges BEFORE stopping: the rolling window is live
+        st = batcher.stats
+        util = st.utilization()
+        dt = st.device_time_snapshot()
+        flops, bytes_ = st.cost_counters()
+        return {
+            "wall_s": round(wall_s, 3),
+            "tokens_served": sum(r for r in results if isinstance(r, int)),
+            "util": util,
+            "device_ms": dt["ms"],
+            "device_tokens": dt["tokens"],
+            "goodput_tokens_per_device_s": st.goodput_tokens_per_device_s(),
+            "dispatch_ms_total": st.dispatch_ms_total,
+            "flops_total": sum(flops.values()),
+            "bytes_total": sum(bytes_.values()),
+        }
+
+    try:
+        out = asyncio.run(drive())
+    finally:
+        batcher.stop()
+
+    for cls in ("prefill", "decode"):
+        u = out["util"][cls]
+        assert u["mfu"] > 0 and u["mbu"] > 0, (
+            f"{cls} roofline gauges are zero (cost extraction broken?): "
+            f"{out['util']}"
+        )
+    ledger_ms = sum(out["device_ms"].values())
+    busy_ms = out["dispatch_ms_total"]
+    assert busy_ms > 0, "no dispatches were timed"
+    drift_pct = abs(ledger_ms - busy_ms) / busy_ms * 100
+    assert drift_pct <= 10.0, (
+        f"device-time ledger ({ledger_ms:.1f} ms) does not reconcile with "
+        f"measured dispatch time ({busy_ms:.1f} ms): {drift_pct:.1f}% apart"
+    )
+    served_ms = out["device_ms"].get("served", 0.0)
+    waste_pct = {
+        k: round(v / ledger_ms * 100, 2)
+        for k, v in sorted(out["device_ms"].items()) if v > 0 and k != "served"
+    }
+    pf, pb = chip_peaks()
+    result = {
+        "requests": n_reqs, "max_new": max_new,
+        "wall_s": out["wall_s"],
+        "tokens_served": out["tokens_served"],
+        "peak_flops": pf, "peak_hbm_bytes_s": pb,
+        "mfu_prefill": round(out["util"]["prefill"]["mfu"], 6),
+        "mbu_prefill": round(out["util"]["prefill"]["mbu"], 6),
+        "mfu_decode": round(out["util"]["decode"]["mfu"], 6),
+        "mbu_decode": round(out["util"]["decode"]["mbu"], 6),
+        "device_ms": {k: round(v, 1) for k, v in sorted(out["device_ms"].items()) if v},
+        "served_ms_pct": round(served_ms / ledger_ms * 100, 2) if ledger_ms else 0.0,
+        "waste_pct": waste_pct,
+        "goodput_tokens_per_device_s": round(out["goodput_tokens_per_device_s"], 1),
+        "ledger_vs_dispatch_pct": round(drift_pct, 2),
+        "flops_total": out["flops_total"],
+        "bytes_total": out["bytes_total"],
+    }
+    gc.collect()
+    return result
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -3215,6 +3348,14 @@ def main() -> None:
             _run_phase(tiny_detail, "obs_overhead", lambda: obs_overhead_bench(
                 cfg, params, seq=128, slots=2, n_reqs=2, max_new=12, rounds=2,
             ))
+        if os.environ.get("BENCH_EFFICIENCY", "1") != "0":
+            # micro-run of the compute-efficiency phase: nonzero MFU/MBU
+            # for both program classes + device-time ledger reconciliation
+            # under the served/cancel/deadline mix (CI smoke asserts the
+            # phase lands in the detail)
+            _run_phase(tiny_detail, "efficiency", lambda: efficiency_bench(
+                cfg, params, seq=128, slots=2, n_reqs=6, max_new=16,
+            ))
         if os.environ.get("BENCH_CHAOS", "1") != "0":
             # fault-injected serving: recovery must hold in CI smoke too
             _run_phase(tiny_detail, "chaos", chaos_bench)
@@ -3364,6 +3505,13 @@ def main() -> None:
     # -- observability overhead: flight recorder on vs off -------------------
     if os.environ.get("BENCH_OBS", "1") != "0":
         _run_phase(detail, "obs_overhead", lambda: obs_overhead_bench(
+            cfg, params
+        ))
+        gc.collect()
+
+    # -- compute efficiency: MFU/MBU roofline + waste attribution ------------
+    if os.environ.get("BENCH_EFFICIENCY", "1") != "0":
+        _run_phase(detail, "efficiency", lambda: efficiency_bench(
             cfg, params
         ))
         gc.collect()

@@ -203,6 +203,17 @@ class Gateway:
             ).strip().lower() not in ("0", "false", "off")
         self.obs_spans = obs_spans
         self.ident = ident  # worker_id stamped on this gateway's spans
+        # cluster advert cadence (0 disables): the aggregator scrapes every
+        # advert member's directed metrics.prom subject, so advertising is
+        # what folds lmstudio_gateway_* into the cluster exposition. The
+        # role marks the advert metrics-only — the router must never route
+        # a chat at the gateway (serve/router.py filters role "gateway").
+        self.advert_interval_s = float(
+            os.environ.get("GATEWAY_ADVERT_INTERVAL_S", "1.0") or 0
+        )
+        self._advert_seq = 0
+        self._advert_task: asyncio.Task | None = None
+        self._metrics_sub = None
         self._sem = asyncio.Semaphore(max(1, max_conn))
         self._server: asyncio.base_events.Server | None = None
         self.requests_total = 0
@@ -224,16 +235,76 @@ class Gateway:
             await self.router.start()
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        # directed scrape surface (same shape as the workers'): the fleet
+        # aggregator requests {prefix}.worker.<id>.metrics.prom for every
+        # advert member, so this sub + the advert loop below are all it
+        # takes for the HTTP-edge families to join the cluster exposition
+        self._metrics_sub = await self.nc.subscribe(
+            f"{self.prefix}.worker.{self.ident}.metrics.prom",
+            cb=self._on_metrics_prom,
+        )
+        if self.advert_interval_s > 0:
+            self._advert_task = asyncio.ensure_future(self._advert_loop())
         log.info("gateway on http://%s:%d -> %s.*", self.host, self.port, self.prefix)
         return self
 
     async def stop(self) -> None:
+        if self._advert_task is not None:
+            self._advert_task.cancel()
+            self._advert_task = None
+        if self._metrics_sub is not None:
+            try:
+                await self._metrics_sub.unsubscribe()
+            except (ConnectionError, ValueError):
+                pass
+            self._metrics_sub = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
         if self._own_router:
             await self.router.stop()
+
+    async def _on_metrics_prom(self, msg) -> None:
+        """Directed metrics.prom — raw Prometheus text, exactly like the
+        workers' subject (scrapers want the body, not a JSON envelope)."""
+        if msg.reply:
+            try:
+                await self.nc.publish(msg.reply, self.render_prometheus().encode())
+            except (ConnectionError, ValueError):
+                pass
+
+    def build_advert(self) -> dict:
+        """Minimal membership advert: identity + role "gateway". Serves no
+        chat (the router filters the role out of its candidates); exists so
+        the aggregator discovers and scrapes this process like a worker."""
+        return {
+            "worker_id": self.ident,
+            "role": "gateway",
+            "queue_depth": 0,
+            "brownout": 0,
+            "hbm_headroom": 1.0,
+            "models": [],
+            "draining": False,
+            "heads": [],
+            "seq": self._advert_seq,
+        }
+
+    async def _advert_loop(self) -> None:
+        try:
+            while True:
+                self._advert_seq += 1
+                try:
+                    await self.nc.publish(
+                        f"{self.prefix}.cluster.adverts",
+                        json.dumps(self.build_advert(),
+                                   separators=(",", ":")).encode(),
+                    )
+                except (ConnectionError, ValueError):
+                    pass  # reconnect in flight; the next tick re-advertises
+                await asyncio.sleep(self.advert_interval_s)
+        except asyncio.CancelledError:
+            return
 
     # -- HTTP plumbing -------------------------------------------------------
 

@@ -25,6 +25,20 @@ from .histogram import (
 )
 from .prom import PromRenderer
 from .recorder import FlightRecorder
+from .roofline import (
+    DECODE_PROGRAMS,
+    PREFILL_PROGRAMS,
+    SPEC_PROGRAMS,
+    WASTE_CATEGORIES,
+    HbmLedger,
+    RollingUtilization,
+    chip_peaks,
+    classify_program,
+    dispatch_shape_key,
+    efficiency_enabled,
+    extract_dispatch_cost,
+    resolve_chip_peaks,
+)
 from .trace import (
     STAGES,
     Span,
@@ -54,6 +68,18 @@ __all__ = [
     "merge",
     "quantile",
     "PromRenderer",
+    "DECODE_PROGRAMS",
+    "PREFILL_PROGRAMS",
+    "SPEC_PROGRAMS",
+    "WASTE_CATEGORIES",
+    "HbmLedger",
+    "RollingUtilization",
+    "chip_peaks",
+    "classify_program",
+    "dispatch_shape_key",
+    "efficiency_enabled",
+    "extract_dispatch_cost",
+    "resolve_chip_peaks",
     "STAGES",
     "Span",
     "Trace",

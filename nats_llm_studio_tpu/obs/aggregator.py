@@ -102,16 +102,27 @@ def _resolve_family(name: str, types: dict[str, str]) -> tuple[str, str, str] | 
     return None
 
 
+# Ratio/rate gauges where summing across workers is meaningless (a 2-worker
+# fleet at 40% MFU each is NOT at 80%): these average over the contributing
+# samples instead. Totals-style families (device_ms, flops, bytes) still sum.
+MEAN_GAUGE_FAMILIES = frozenset({
+    "lmstudio_mfu",
+    "lmstudio_mbu",
+    "lmstudio_goodput_tokens_per_device_s",
+})
+
+
 def merge_into(renderer: PromRenderer, texts: list[str],
                drop_labels: tuple[str, ...] = ("worker_id",)) -> None:
     """Merge N workers' expositions into ``renderer`` as one cluster view.
 
     Counters and gauges sum across workers by their remaining label sets
-    once ``drop_labels`` are removed; histogram families merge delta-first
-    per label group (each worker's cumulative buckets convert to deltas
-    before edges combine — see :class:`obs.histogram.MergedHist`) and are
-    re-rendered spec-clean: one TYPE line per family, cumulative monotone
-    buckets, ``+Inf`` == ``_count``.
+    once ``drop_labels`` are removed (except :data:`MEAN_GAUGE_FAMILIES`,
+    which average); histogram families merge delta-first per label group
+    (each worker's cumulative buckets convert to deltas before edges
+    combine — see :class:`obs.histogram.MergedHist`) and are re-rendered
+    spec-clean: one TYPE line per family, cumulative monotone buckets,
+    ``+Inf`` == ``_count``.
     """
     types: dict[str, str] = {}
     parsed: list[list[tuple[str, dict, float]]] = []
@@ -123,6 +134,7 @@ def merge_into(renderer: PromRenderer, texts: list[str],
 
     order: list[tuple[str, str]] = []  # (family, type) in first-seen order
     scalars: dict[str, dict[tuple, float]] = {}
+    scalar_n: dict[str, dict[tuple, int]] = {}  # sample counts for means
     hist_series: dict[str, dict[tuple, dict[tuple, list]]] = {}
     hist_sums: dict[str, dict[tuple, float]] = {}
 
@@ -143,6 +155,8 @@ def merge_into(renderer: PromRenderer, texts: list[str],
                 scalars.setdefault(family, {})
                 k = _key(labels)
                 scalars[family][k] = scalars[family].get(k, 0.0) + value
+                n = scalar_n.setdefault(family, {})
+                n[k] = n.get(k, 0) + 1
             elif typ == "histogram":
                 if (family, typ) not in order:
                     order.append((family, typ))
@@ -176,8 +190,12 @@ def merge_into(renderer: PromRenderer, texts: list[str],
                                    labels=dict(gk))
         else:
             add = renderer.counter if typ == "counter" else renderer.gauge
+            mean = typ == "gauge" and family in MEAN_GAUGE_FAMILIES
             for k in sorted(scalars.get(family, {})):
-                add(family, scalars[family][k], labels=dict(k))
+                v = scalars[family][k]
+                if mean:
+                    v /= max(scalar_n.get(family, {}).get(k, 1), 1)
+                add(family, v, labels=dict(k))
 
 
 def merge_expositions(texts: list[str],
@@ -522,7 +540,20 @@ class Aggregator:
     def live_workers(self) -> list[str]:
         """Workers advertising within the staleness window. Draining workers
         stay scrapable — their final counters are exactly what a drain
-        post-mortem needs."""
+        post-mortem needs. Gateway adverts (role "gateway") are scraped
+        (see :meth:`_scrape_targets`) but are not workers: they must not
+        count toward ``lmstudio_cluster_workers`` or scaling signals."""
+        now = time.monotonic()
+        return sorted(
+            wid for wid, m in self._members.items()
+            if now - m["mono"] <= self.stale_after_s
+            and m["advert"].get("role") != "gateway"
+        )
+
+    def _scrape_targets(self) -> list[str]:
+        """Everything advertising a directed ``metrics.prom`` subject —
+        live workers plus gateway-role members, whose lmstudio_gateway_*
+        families fold into the cluster exposition."""
         now = time.monotonic()
         return sorted(
             wid for wid, m in self._members.items()
@@ -545,14 +576,18 @@ class Aggregator:
             return
 
     async def scrape_once(self) -> dict[str, str]:
-        """One scrape tick: request every live worker's directed exposition,
-        refresh the merged view, advance the SLO windows, publish alerts."""
+        """One scrape tick: request every advert member's directed exposition
+        (workers AND gateway-role members), refresh the merged view, advance
+        the SLO windows, publish alerts. Returns the WORKER texts only —
+        gateway expositions fold into :meth:`render_cluster` but carry no
+        serving signals, so they stay out of the SLO windows and out of the
+        callers' per-worker view."""
         # prune long-dead members so the map cannot grow without bound
         now_mono = time.monotonic()
         for wid in [w for w, m in self._members.items()
                     if now_mono - m["mono"] > 10 * self.stale_after_s]:
             del self._members[wid]
-        members = self.live_workers()
+        members = self._scrape_targets()
         results = await asyncio.gather(
             *(self.nc.request(f"{self.prefix}.worker.{wid}.metrics.prom", b"",
                               timeout=self.scrape_timeout_s)
@@ -567,6 +602,8 @@ class Aggregator:
                 texts[wid] = res.payload.decode("utf-8", errors="replace")
         self.scrapes_total += 1
         self._last_texts = texts
+        workers = set(self.live_workers())
+        texts = {wid: t for wid, t in texts.items() if wid in workers}
 
         per_worker = {
             wid: SloEvaluator.sample_from_exposition(t) for wid, t in texts.items()

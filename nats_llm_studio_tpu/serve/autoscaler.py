@@ -266,6 +266,9 @@ class Autoscaler:
             wid for wid, m in self._members.items()
             if now - m["mono"] <= self.stale_after_s
             and not m["advert"].get("draining")
+            # gateway adverts are metrics-only membership: zero-depth
+            # non-serving entries must not dilute the scaling signals
+            and m["advert"].get("role") != "gateway"
         )
 
     def _prune(self, now: float) -> None:

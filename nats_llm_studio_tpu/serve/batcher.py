@@ -38,6 +38,15 @@ from ..models.llama import forward, make_cache
 from ..engine.sampling import sample_rows, spec_accept_rows
 from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
+from ..obs.roofline import (
+    SPEC_PROGRAMS,
+    WASTE_CATEGORIES,
+    RollingUtilization,
+    classify_program,
+    dispatch_shape_key,
+    efficiency_enabled,
+    extract_dispatch_cost,
+)
 from ..transport import faults as _faults
 from ..ops.kvcache import (
     KVQ,
@@ -168,6 +177,19 @@ class _Request:
     # program — so token 0 obeys the mask and carries logprobs like every
     # later token, without a separate masked-prefill program family
     rewound: bool = False
+    # -- device-time ledger (obs/roofline.py) -----------------------------
+    # dispatch ms accrued on behalf of this request, split by program class;
+    # finalized into BatcherStats.device_ms under an outcome category when
+    # the request leaves (served / cancelled / deadline_abort / shed / ...)
+    dev_prefill_ms: float = 0.0
+    dev_decode_ms: float = 0.0
+    # this request's share of its most recent spec-verify dispatch, so the
+    # readback can move the rejected-draft fraction to "spec_rejected"
+    dev_spec_ms: float = 0.0
+    # outcome tag for prefill work that only exists because an upstream step
+    # failed (disaggregated KV pull fell back to a local re-prefill): the
+    # prefill share of a served request lands here instead of "served"
+    waste_tag: str | None = None
 
     @property
     def is_ext(self) -> bool:
@@ -225,12 +247,29 @@ class BatcherStats:
     # record; exposition copies the dict under the lock.
     program_ms: dict = field(default_factory=dict)  # name -> LogHistogram
     program_tokens: dict = field(default_factory=dict)  # name -> LogHistogram
+    # -- compute-efficiency plane (obs/roofline.py) -----------------------
+    # cumulative per-program flops / bytes-accessed from XLA cost analysis;
+    # keys materialize on the first costed dispatch of each program
+    program_flops: dict = field(default_factory=dict)  # name -> float
+    program_bytes: dict = field(default_factory=dict)  # name -> float
+    # device-time ledger: outcome category -> accumulated dispatch ms, and
+    # tokens delivered (tokens accrue only under "served")
+    device_ms: dict = field(default_factory=dict)
+    device_tokens: dict = field(default_factory=dict)
+    # exact sum of every dispatch's ms (the same samples program_ms buckets
+    # approximately): reconciliation denominator for the ledger — the bench
+    # `efficiency` phase asserts category sums match this within 10%
+    dispatch_ms_total: float = 0.0
+    # rolling flops/bytes windows per program class -> MFU/MBU gauges
+    util_prefill: RollingUtilization = field(default_factory=RollingUtilization)
+    util_decode: RollingUtilization = field(default_factory=RollingUtilization)
     _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def record_program(self, name: str, ms: float, tokens: float | None = None) -> None:
         """One jit-grid dispatch of ``name`` took ``ms`` (host wall: on an
         async backend this is dispatch time — execution may still be in
         flight — but a cold call's trace+compile is fully in here)."""
+        self.dispatch_ms_total += ms  # owner-thread single writer
         h = self.program_ms.get(name)
         if h is None:
             with self._lock:
@@ -252,6 +291,64 @@ class BatcherStats:
     def program_token_histograms(self) -> dict[str, LogHistogram]:
         with self._lock:
             return dict(self.program_tokens)
+
+    def record_dispatch_cost(self, name: str, cost: tuple | None) -> None:
+        """Fold one dispatch's (flops, bytes) into the per-program totals and
+        the rolling roofline windows. ``cost`` is None when XLA cost analysis
+        was unavailable for the program — the dispatch simply isn't costed."""
+        if not cost:
+            return
+        fl, by = cost
+        with self._lock:
+            self.program_flops[name] = self.program_flops.get(name, 0.0) + fl
+            self.program_bytes[name] = self.program_bytes.get(name, 0.0) + by
+        cls = classify_program(name)
+        if cls == "prefill":
+            self.util_prefill.add(fl, by)
+        elif cls == "decode":
+            self.util_decode.add(fl, by)
+
+    def attribute_device_time(self, category: str, ms: float, tokens: int = 0) -> None:
+        """Ledger entry: ``ms`` of device dispatch time resolved to an outcome
+        ``category`` (roofline.WASTE_CATEGORIES, plus "failed" for crash
+        paths). Tokens count only toward goodput ("served")."""
+        with self._lock:
+            self.device_ms[category] = self.device_ms.get(category, 0.0) + ms
+            if tokens:
+                self.device_tokens[category] = self.device_tokens.get(category, 0) + tokens
+
+    def device_time_snapshot(self) -> dict:
+        """{"ms": {category: ms}, "tokens": {category: n}} — the standard
+        categories are always present (zero-filled) so exposition and the
+        cluster rollup see stable families."""
+        with self._lock:
+            ms = {c: 0.0 for c in WASTE_CATEGORIES}
+            ms.update(self.device_ms)
+            tok = {c: 0 for c in WASTE_CATEGORIES}
+            tok.update(self.device_tokens)
+        return {"ms": ms, "tokens": tok}
+
+    def goodput_tokens_per_device_s(self) -> float:
+        """Served tokens per second of TOTAL attributed device time — waste
+        in any category drags this below raw decode throughput."""
+        with self._lock:
+            total_ms = sum(self.device_ms.values())
+            served = self.device_tokens.get("served", 0)
+        return served / (total_ms / 1e3) if total_ms > 0 else 0.0
+
+    def cost_counters(self) -> tuple[dict, dict]:
+        """(program_flops, program_bytes) copies for exposition."""
+        with self._lock:
+            return dict(self.program_flops), dict(self.program_bytes)
+
+    def utilization(self, peaks: tuple | None = None) -> dict:
+        """Rolling MFU/MBU per program class against chip peaks."""
+        pf_mfu, pf_mbu = self.util_prefill.utilization(peaks)
+        dc_mfu, dc_mbu = self.util_decode.utilization(peaks)
+        return {
+            "prefill": {"mfu": pf_mfu, "mbu": pf_mbu},
+            "decode": {"mfu": dc_mfu, "mbu": dc_mbu},
+        }
 
     def record_admit_delay(self, ms: float) -> None:
         """Queue delay (enqueue -> admit DISPATCH), ms — the scheduling
@@ -348,6 +445,10 @@ class BatcherStats:
             "prefill_p95_ms": round(pre.percentile(0.95), 1),
             "decode_step_p50_ms": round(dec.percentile(0.5), 1),
             "decode_step_p95_ms": round(dec.percentile(0.95), 1),
+            "goodput_tokens_per_device_s": round(self.goodput_tokens_per_device_s(), 2),
+            "device_ms": {
+                k: round(v, 1) for k, v in self.device_time_snapshot()["ms"].items()
+            },
         }
 
 
@@ -541,6 +642,15 @@ class ContinuousBatcher:
         # recorder frame's one-number answer to "is spec still paying?"
         self._spec_accept_ewma = 0.0
         self.stats = BatcherStats()
+        # compute-efficiency plane (obs/roofline.py): per-dispatch cost
+        # extraction + the device-time ledger. EFFICIENCY=0 disables both
+        # (the _timed wrapper then degrades to the plain timer).
+        self._efficiency = efficiency_enabled()
+        # the requests the in-progress dispatch works for (owner thread
+        # only); _timed splits each dispatch's ms across this context, and
+        # dispatches with no context are ledgered as "other" (warmup,
+        # compaction, CoW copies)
+        self._charge_ctx: tuple | None = None
         # flight recorder (obs/recorder.py): the owner loop samples one
         # frame per interval and the anomaly paths (crash, pool
         # exhaustion, SHED_ONLY entry) dump through it; None = off
@@ -1255,17 +1365,77 @@ class ContinuousBatcher:
         tokens-per-dispatch in program_tokens[name]). Times the host-side
         call only — it never blocks on the result, so the depth-2 decode
         pipeline is untouched; decode_step_ms remains the
-        readback-inclusive per-step number."""
+        readback-inclusive per-step number.
+
+        With the efficiency plane on, the first dispatch per shape-bucket
+        also extracts flops/bytes from XLA cost analysis — BEFORE the call,
+        because the programs donate their input buffers — and every dispatch
+        then folds into the roofline counters plus, via the owner thread's
+        charge context, the per-request device-time ledger. A failed
+        extraction caches None so a program is probed at most once per
+        shape."""
         stats = self.stats
+        eff = self._efficiency
+        cost_cache: dict = {}
+        is_prefill = classify_program(name) == "prefill"
+        is_spec = name in SPEC_PROGRAMS
 
         def run(*args, _tokens=None, **kwargs):
+            cost = None
+            if eff:
+                key = dispatch_shape_key(args, kwargs)
+                try:
+                    cost = cost_cache[key]
+                except KeyError:
+                    cost = extract_dispatch_cost(fn, args, kwargs)
+                    cost_cache[key] = cost
             t0 = time.monotonic()
             out = fn(*args, **kwargs)
-            stats.record_program(name, (time.monotonic() - t0) * 1e3, _tokens)
+            ms = (time.monotonic() - t0) * 1e3
+            stats.record_program(name, ms, _tokens)
+            if eff:
+                stats.record_dispatch_cost(name, cost)
+                ctx = self._charge_ctx
+                if ctx:
+                    share = ms / len(ctx)
+                    for r in ctx:
+                        if is_prefill:
+                            r.dev_prefill_ms += share
+                        else:
+                            r.dev_decode_ms += share
+                            if is_spec:
+                                r.dev_spec_ms = share
+                else:
+                    stats.attribute_device_time("other", ms)
             return out
 
         run.__name__ = f"timed_{name}"
         return run
+
+    def _ledger_finalize(self, req, category: str) -> None:
+        """Resolve a request's accrued device time into an outcome category.
+
+        ``category`` is one of roofline.WASTE_CATEGORIES (or "failed" for
+        crash paths). A served request with a ``waste_tag`` (disaggregated
+        KV-pull fallback) books its prefill share under the tag — that work
+        only happened because the transfer failed. Tolerates duck-typed
+        inbox entries (_ControlOp): they never accrue."""
+        if not self._efficiency:
+            return
+        pre = getattr(req, "dev_prefill_ms", 0.0)
+        dec = getattr(req, "dev_decode_ms", 0.0)
+        if pre <= 0.0 and dec <= 0.0:
+            return
+        req.dev_prefill_ms = req.dev_decode_ms = req.dev_spec_ms = 0.0
+        st = self.stats
+        if category == "served":
+            if req.waste_tag and pre > 0.0:
+                st.attribute_device_time(req.waste_tag, pre)
+                st.attribute_device_time("served", dec, req.generated)
+            else:
+                st.attribute_device_time("served", pre + dec, req.generated)
+        else:
+            st.attribute_device_time(category, pre + dec)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1303,6 +1473,7 @@ class ContinuousBatcher:
                     extra={
                         "error": f"{type(e).__name__}: {e}",
                         "inflight_failed": n,
+                        "device_ms": self.stats.device_time_snapshot()["ms"],
                     },
                 )
 
@@ -1323,6 +1494,7 @@ class ContinuousBatcher:
             # the stats counter (health/metrics scrape) immediately
             nonlocal n
             n += 1
+            self._ledger_finalize(req, "failed")
             self.stats.inflight_failed_retryable += 1
             req.emit("err", err)
 
@@ -1379,6 +1551,13 @@ class ContinuousBatcher:
             fr["pool_blocks_free"] = ps["blocks_free"]
             fr["pool_blocks_live"] = ps["blocks_live"]
             fr["pool_blocks_shared"] = ps["blocks_shared"]
+        if self._efficiency:
+            dt = st.device_time_snapshot()["ms"]
+            # only nonzero categories: frames are size-sensitive
+            fr["device_ms"] = {k: round(v, 1) for k, v in dt.items() if v}
+            fr["goodput_tokens_per_device_s"] = round(
+                st.goodput_tokens_per_device_s(), 1
+            )
         return fr
 
     def debug_snapshot(self) -> dict:
@@ -1592,6 +1771,7 @@ class ContinuousBatcher:
         constrain=None,
         want_logprobs: bool = False,
         top_logprobs: int = 0,
+        waste_tag: str | None = None,
     ) -> _Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -1620,6 +1800,7 @@ class ContinuousBatcher:
             cstate=constrain.start if constrain is not None else 0,
             want_logprobs=want_logprobs or top_logprobs > 0,
             top_logprobs=int(top_logprobs),
+            waste_tag=waste_tag,
         )
         if trace is not None:
             trace.mark("enqueue", req.t_enq)
@@ -1690,6 +1871,7 @@ class ContinuousBatcher:
         constrain=None,
         want_logprobs: bool = False,
         top_logprobs: int = 0,
+        waste_tag: str | None = None,
     ) -> AsyncIterator[int]:
         """Yield generated token ids for one request.
 
@@ -1702,7 +1884,7 @@ class ContinuousBatcher:
         async for batch in self.submit_batched(
             prompt_ids, sp, info=info, trace=trace, deadline=deadline,
             constrain=constrain, want_logprobs=want_logprobs,
-            top_logprobs=top_logprobs,
+            top_logprobs=top_logprobs, waste_tag=waste_tag,
         ):
             for tok in batch:
                 yield tok
@@ -1717,6 +1899,7 @@ class ContinuousBatcher:
         constrain=None,
         want_logprobs: bool = False,
         top_logprobs: int = 0,
+        waste_tag: str | None = None,
     ) -> AsyncIterator[list]:
         """Like ``submit`` but yields LISTS of tokens: everything already
         delivered when the consumer wakes comes out as one batch. A decode
@@ -1737,7 +1920,7 @@ class ContinuousBatcher:
         req = self._enqueue(
             prompt_ids, sp, trace=trace, deadline=deadline,
             constrain=constrain, want_logprobs=want_logprobs,
-            top_logprobs=top_logprobs,
+            top_logprobs=top_logprobs, waste_tag=waste_tag,
         )
         done = False
         try:
@@ -1912,7 +2095,8 @@ class ContinuousBatcher:
                     # admit attempt, one dump per window tells the story
                     self.recorder.dump(
                         "kv_pool_exhausted",
-                        extra={"needed": k, "free": pool.free_blocks},
+                        extra={"needed": k, "free": pool.free_blocks,
+                               "device_ms": self.stats.device_time_snapshot()["ms"]},
                     )
                 raise _PoolExhausted(
                     f"kv block pool exhausted ({k} blocks needed, "
@@ -2085,6 +2269,9 @@ class ContinuousBatcher:
                     if self._slots[slot] is not req:
                         continue  # finished at an earlier record; zombie rows
                     if req.cancelled:
+                        self._ledger_finalize(
+                            req, "deadline_abort" if req.deadline_hit else "cancelled"
+                        )
                         finish_slot(slot)
                         self.stats.record_cancel(
                             "deadline" if req.deadline_hit else "decode"
@@ -2099,11 +2286,13 @@ class ContinuousBatcher:
                                 st.index.append(t)
                             reason = self._deliver(req, t)
                             if reason is not None:
+                                self._ledger_finalize(req, "served")
                                 finish_slot(slot)  # free BEFORE the end event
                                 req.emit("end", reason)
                                 break
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
+                        self._ledger_finalize(req, "cancelled")
                         finish_slot(slot)
             elif rec[0] == "spec":
                 _, out_ref, nacc_ref, rows, t_disp = rec
@@ -2127,7 +2316,25 @@ class ContinuousBatcher:
                         self._spec_accept_ewma = (
                             rate if prev == 0.0 else 0.8 * prev + 0.2 * rate
                         )
+                        if self._efficiency and req.dev_spec_ms > 0.0:
+                            # ledger: the rejected-draft fraction of this
+                            # verify's cost moves out of the request's
+                            # accrual immediately — it can never serve a
+                            # token, whatever the request's outcome
+                            waste = min(
+                                req.dev_spec_ms * (dlen + 1 - n_emit) / (dlen + 1),
+                                req.dev_decode_ms,
+                            )
+                            if waste > 0.0:
+                                req.dev_decode_ms -= waste
+                                self.stats.attribute_device_time(
+                                    "spec_rejected", waste
+                                )
+                            req.dev_spec_ms = 0.0
                     if req.cancelled:
+                        self._ledger_finalize(
+                            req, "deadline_abort" if req.deadline_hit else "cancelled"
+                        )
                         finish_slot(slot)
                         self.stats.record_cancel(
                             "deadline" if req.deadline_hit else "decode"
@@ -2142,11 +2349,13 @@ class ContinuousBatcher:
                                 st.index.append(t)
                             reason = self._deliver(req, t)
                             if reason is not None:
+                                self._ledger_finalize(req, "served")
                                 finish_slot(slot)  # free BEFORE the end event
                                 req.emit("end", reason)
                                 break
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
+                        self._ledger_finalize(req, "cancelled")
                         finish_slot(slot)
             elif rec[0] == "ext":
                 _, toks_ref, lp_ref, topids_ref, toplps_ref, rows, t_disp = rec
@@ -2161,6 +2370,9 @@ class ContinuousBatcher:
                     if self._slots[slot] is not req:
                         continue
                     if req.cancelled:
+                        self._ledger_finalize(
+                            req, "deadline_abort" if req.deadline_hit else "cancelled"
+                        )
                         finish_slot(slot)
                         self.stats.record_cancel(
                             "deadline" if req.deadline_hit else "decode"
@@ -2194,10 +2406,12 @@ class ContinuousBatcher:
                             # the constrained output is complete
                             reason = "stop"
                         if reason is not None:
+                            self._ledger_finalize(req, "served")
                             finish_slot(slot)  # free BEFORE the end event
                             req.emit("end", reason)
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
+                        self._ledger_finalize(req, "cancelled")
                         finish_slot(slot)
             else:
                 _, firsts_ref, rows = rec
@@ -2206,6 +2420,9 @@ class ContinuousBatcher:
                     if self._slots[slot] is not req:
                         continue
                     if req.cancelled:
+                        self._ledger_finalize(
+                            req, "deadline_abort" if req.deadline_hit else "cancelled"
+                        )
                         finish_slot(slot)
                         self.stats.record_cancel(
                             "deadline" if req.deadline_hit else "admit"
@@ -2234,6 +2451,7 @@ class ContinuousBatcher:
                         first = int(ids[row])
                         reason = self._deliver(req, first)
                         if reason is not None:
+                            self._ledger_finalize(req, "served")
                             finish_slot(slot)  # free BEFORE the end event
                             req.emit("end", reason)
                         elif spec is not None:
@@ -2244,6 +2462,7 @@ class ContinuousBatcher:
                             )
                     except Exception:  # noqa: BLE001 — dead client
                         log.exception("delivery failed; dropping slot %d", slot)
+                        self._ledger_finalize(req, "cancelled")
                         finish_slot(slot)
 
         def pump(depth: int = 1) -> None:
@@ -2267,6 +2486,9 @@ class ContinuousBatcher:
                 except _queue.Empty:
                     return
                 if 0 <= req.slot < B and self._slots[req.slot] is req:
+                    self._ledger_finalize(
+                        req, "deadline_abort" if req.deadline_hit else "cancelled"
+                    )
                     finish_slot(req.slot)
                     self.stats.record_cancel("active")
                 elif req in waitlist:
@@ -2322,6 +2544,13 @@ class ContinuousBatcher:
             act = active()
             if not act:
                 return
+            # charge this burst (and its CoW/alloc side dispatches) to the
+            # active requests; restore the previous context because decode
+            # interleaves inside admit chunk loops
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = tuple(
+                r for r in (self._slots[i] for i in act) if isinstance(r, _Request)
+            )
             refresh_rows()
             # cap the burst so no active row can run past the cache capacity.
             # n is a static jit arg: snap to single steps near capacity
@@ -2398,6 +2627,7 @@ class ContinuousBatcher:
             inflight.append(
                 ("decode", toks, n, [(i, self._slots[i]) for i in act], time.monotonic())
             )
+            self._charge_ctx = prev_ctx
 
         def decode_ext_once() -> None:
             """Dispatch ONE masked single-step decode covering every active
@@ -2411,6 +2641,10 @@ class ContinuousBatcher:
             act = active()
             if not act:
                 return
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = tuple(
+                r for r in (self._slots[i] for i in act) if isinstance(r, _Request)
+            )
             refresh_rows()
             mask = np.ones((B, cfg.vocab_size), dtype=bool)
             for i in act:
@@ -2450,6 +2684,7 @@ class ContinuousBatcher:
                 ("ext", toks, lps, top_ids, top_lps,
                  [(i, self._slots[i]) for i in act], time.monotonic())
             )
+            self._charge_ctx = prev_ctx
 
         def spec_once() -> bool:
             """Dispatch ONE verify forward when at least one live slot has a
@@ -2482,6 +2717,10 @@ class ContinuousBatcher:
                     total += len(d)
             if total == 0:
                 return False  # nothing to verify: a plain burst is cheaper
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = tuple(
+                r for r in (self._slots[i] for i in act) if isinstance(r, _Request)
+            )
             refresh_rows()
             if paged:
                 for i in act:
@@ -2519,6 +2758,7 @@ class ContinuousBatcher:
                 [(i, self._slots[i], dlens[i]) for i in act],
                 time.monotonic(),
             ))
+            self._charge_ctx = prev_ctx
             return True
 
         pc = self.prefix_cache
@@ -2868,6 +3108,10 @@ class ContinuousBatcher:
             if req.trace is not None:
                 req.trace.mark("admit", t_admit)
             self.stats.record_admit_delay((t_admit - req.t_enq) * 1e3)
+            # every dispatch until the finish (including interleaved decode's
+            # own re-scoped context) charges this request's ledger accrual
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = (req,)
             slot = self._slots.index(None)
             n = len(req.prompt_ids)
             C = self.prefill_chunk
@@ -2899,6 +3143,7 @@ class ContinuousBatcher:
                         tables[slot] = []
                         table_dirty = True
                     self._slots[slot] = None
+                    self._charge_ctx = prev_ctx
                     raise
             elif n <= C:
                 # short prompt: the whole admit is one fused dispatch
@@ -3041,6 +3286,7 @@ class ContinuousBatcher:
             if req.trace is not None:
                 req.trace.mark("prefill")  # prefill dispatched; first token next
             inflight.append(("admit", first, [(0, slot, req)]))
+            self._charge_ctx = prev_ctx
 
         def note_admit(n: int) -> None:
             """Shared cold-ring / wrap bookkeeping for an admit of length n
@@ -3082,6 +3328,8 @@ class ContinuousBatcher:
                     pc.reclaim(need - pool.free_blocks)
                 if need > pool.free_blocks:
                     return False
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = tuple(reqs)
             slots: list[int] = []
             try:
                 for r in reqs:
@@ -3145,6 +3393,7 @@ class ContinuousBatcher:
                         pool.decref(tables[s])
                         tables[s] = []
                         table_dirty = True
+                self._charge_ctx = prev_ctx
                 raise
             dirty = True
             self.stats.grouped_admits += len(reqs)
@@ -3165,6 +3414,7 @@ class ContinuousBatcher:
                 host_seed[s] = seeds[j]
                 rows.append((j, s, r))
             inflight.append(("admit", firsts, rows))
+            self._charge_ctx = prev_ctx
             return True
 
         def admit_group_chunked(reqs: list[_Request]) -> None:
@@ -3194,8 +3444,13 @@ class ContinuousBatcher:
                         try:
                             admit_one(r)
                         except _PoolExhausted as e:
+                            # chunk prefills may have run before the alloc
+                            # failed: that device time is shed-after-prefill
+                            self._ledger_finalize(r, "shed_after_prefill")
                             r.emit("err", e)
                     return
+            prev_ctx = self._charge_ctx
+            self._charge_ctx = tuple(reqs)
             # queue delay = enqueue -> admission START (scheduling only;
             # the chunk loop's seconds are prefill, not queueing)
             t_start = time.monotonic()
@@ -3313,6 +3568,7 @@ class ContinuousBatcher:
                         pool.decref(tables[s])
                         tables[s] = []
                         table_dirty = True
+                self._charge_ctx = prev_ctx
                 raise
             dirty = True
             self.stats.chunked_group_admits += len(reqs)
@@ -3330,6 +3586,7 @@ class ContinuousBatcher:
                 host_seed[s] = seeds[j]
                 out_rows.append((j, s, r))
             inflight.append(("admit", firsts, out_rows))
+            self._charge_ctx = prev_ctx
 
         def reset_after_failed_dispatch() -> None:
             """A failed admit/decode dispatch may have consumed the donated
@@ -3340,9 +3597,11 @@ class ContinuousBatcher:
             buffers and are discarded."""
             nonlocal K, V, tok_dev, dirty, table_dirty
             inflight.clear()
+            self._charge_ctx = None  # drop any context the failed call left
             err = RuntimeError("batcher cache reset after a failed device dispatch")
             for i, r in enumerate(self._slots):
                 if isinstance(r, _Request):
+                    self._ledger_finalize(r, "failed")
                     r.emit("err", err)
                 if r is not None:  # includes _RESERVED placeholders
                     self._slots[i] = None
@@ -3468,7 +3727,8 @@ class ContinuousBatcher:
                     rec.dump(
                         "shed_only_entry",
                         extra={"depth": depth, "age_p95_ms": round(age_p95, 1),
-                               "hbm_headroom_frac": headroom_frac},
+                               "hbm_headroom_frac": headroom_frac,
+                               "device_ms": self.stats.device_time_snapshot()["ms"]},
                     )
             # deadline sweep, queued side: waiters whose budget already ran
             # out — or whose remaining budget the live rate EWMAs say cannot
@@ -3654,9 +3914,11 @@ class ContinuousBatcher:
                             # raised pre-dispatch: the device pool is intact,
                             # shed the group without the cache reset
                             for req in group:
+                                self._ledger_finalize(req, "shed_after_prefill")
                                 req.emit("err", e)
                         except Exception as e:  # noqa: BLE001 — surface to callers
                             for req in group:
+                                self._ledger_finalize(req, "failed")
                                 req.emit("err", e)
                             reset_after_failed_dispatch()
                         continue
@@ -3674,6 +3936,7 @@ class ContinuousBatcher:
                         handled = admit_group(group, head_bucket)
                     except Exception as e:  # noqa: BLE001 — surface to callers
                         for req in group:
+                            self._ledger_finalize(req, "failed")
                             req.emit("err", e)
                         reset_after_failed_dispatch()
                         continue
@@ -3686,9 +3949,13 @@ class ContinuousBatcher:
                         admit_one(req)
                     except _PoolExhausted as e:
                         # pre-dispatch shed: pool state is intact, the other
-                        # streams keep decoding; no cache reset
+                        # streams keep decoding; no cache reset — but a long
+                        # prompt's chunk prefills may have run before the
+                        # suffix alloc failed: that device time was wasted
+                        self._ledger_finalize(req, "shed_after_prefill")
                         req.emit("err", e)
                     except Exception as e:  # noqa: BLE001 — surface to the caller
+                        self._ledger_finalize(req, "failed")
                         req.emit("err", e)
                         reset_after_failed_dispatch()
             # age bound: requests STILL waiting after admission had its
@@ -3825,6 +4092,9 @@ class ContinuousBatcher:
             waitlist.clear()  # self._waitlist: a later crash must not re-fail these
         for i, req in enumerate(self._slots):
             if isinstance(req, _Request):
+                # whatever streamed before shutdown was served; the ledger
+                # keeps its tokens so goodput stays honest across drains
+                self._ledger_finalize(req, "served")
                 req.emit("end", reason)
             if req is not None:  # includes _RESERVED placeholders
                 self._slots[i] = None

@@ -22,7 +22,7 @@ from ..gguf.reader import open_gguf
 from ..gguf.tokenizer import GGUFTokenizer
 from ..models.config import ModelConfig
 from ..models.llama import load_params_from_gguf
-from ..obs import FlightRecorder, LogHistogram
+from ..obs import FlightRecorder, HbmLedger, LogHistogram, efficiency_enabled
 from ..obs import emit as obs_emit
 from ..parallel.sharding import validate_mesh_for_config
 from ..store.manager import ModelStore, StoreError
@@ -341,6 +341,7 @@ class JaxChatEngine(ChatEngine):
     async def _stream_one(
         self, index: int, prompt_ids: list[int], sp: SamplingParams,
         trace, deadline, dfa, want_lp: bool, top_n: int, result: dict,
+        waste_tag: str | None = None,
     ) -> AsyncIterator[dict]:
         """Drive ONE choice through the batcher: yields OpenAI chunk dicts
         tagged with choice ``index`` and fills ``result`` with the
@@ -358,6 +359,7 @@ class JaxChatEngine(ChatEngine):
         async for tok_batch in self.batcher.submit_batched(
             prompt_ids, sp, info=end_info, trace=trace, deadline=deadline,
             constrain=dfa, want_logprobs=want_lp, top_logprobs=top_n,
+            waste_tag=waste_tag,
         ):
             if not toks:
                 stats.ttft_s = time.perf_counter() - t0
@@ -428,6 +430,12 @@ class JaxChatEngine(ChatEngine):
         # X-Deadline-Ms header, capped by the per-op timeout ladder; popped
         # for the same stays-verbatim reason as the trace
         deadline = payload.pop("_deadline", None)
+        # waste attribution tag injected by the worker (e.g. a failed
+        # disagg KV prefetch forcing a local re-prefill): popped so the
+        # engine-facing payload stays the verbatim OpenAI body, handed to
+        # the batcher which charges this request's prefill device-ms to
+        # that category instead of "served"
+        waste_tag = payload.pop("_waste_tag", None)
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
         dfa, want_lp, top_n, n_choices = self._parse_ext(payload)
@@ -436,13 +444,13 @@ class JaxChatEngine(ChatEngine):
             if n_choices == 1:
                 async for chunk in self._stream_one(
                     0, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
-                    results[0],
+                    results[0], waste_tag=waste_tag,
                 ):
                     yield chunk
             else:
                 async for chunk in self._stream_n(
                     prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
-                    results,
+                    results, waste_tag=waste_tag,
                 ):
                     yield chunk
         except BatcherOverloaded as e:
@@ -474,6 +482,7 @@ class JaxChatEngine(ChatEngine):
 
     async def _stream_n(
         self, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n, results,
+        waste_tag: str | None = None,
     ) -> AsyncIterator[dict]:
         """n>1 fan-out: each choice is its own batcher request. Choice 0
         launches alone; the rest launch after its first chunk, so choice
@@ -498,6 +507,7 @@ class JaxChatEngine(ChatEngine):
                 async for chunk in self._stream_one(
                     i, prompt_ids, sp_for(i), trace if i == 0 else None,
                     deadline, dfa, want_lp, top_n, results[i],
+                    waste_tag=waste_tag if i == 0 else None,
                 ):
                     await queue.put(chunk)
                     if i == 0:
@@ -749,6 +759,34 @@ class LocalRegistry(Registry):
         self.recorder_counters: dict[str, Any] = {
             "engine_restarts": lambda: self.engine_restarts_total,
         }
+        # HBM ledger (obs/roofline.py): reconcile the admission accounting
+        # against the allocator's bytes_in_use on every recorder tick — the
+        # committed estimate already folds block pool + prefix budget, so
+        # components split it for the breakdown rather than re-pricing.
+        # HBM_WORKSPACE_SLACK_BYTES prices XLA scratch/workspace the
+        # admission model deliberately ignores; the ledger's baseline
+        # absorbs whatever constant slack remains unpriced.
+        try:
+            _slack = int(os.environ.get("HBM_WORKSPACE_SLACK_BYTES", "0") or 0)
+        except ValueError:
+            _slack = 0
+        self.hbm_ledger = HbmLedger(
+            {
+                "engines": lambda: (
+                    sum(self._hbm_committed.values())
+                    - sum(self._prefix_bytes.values())
+                ),
+                "prefix_cache": lambda: sum(self._prefix_bytes.values()),
+                "workspace_slack": lambda: _slack,
+            },
+            emit_fn=obs_emit,
+        )
+        # ticking inside the counter fn puts each reconciliation sample on
+        # the recorder frame timeline for free (and into anomaly dumps).
+        # EFFICIENCY=0 kills the whole plane: no ticks, no hbm_drift
+        # events, and (per the worker's gates) no exposition families
+        if efficiency_enabled():
+            self.recorder_counters["hbm_drift_bytes"] = self.hbm_ledger.tick
         # pull-time precompile (ISSUE 15): at pull_model, compile the full
         # jit grid into the persistent compile cache so a replacement
         # worker's first request replays warm compiles. Only active when a
@@ -1350,6 +1388,7 @@ class LocalRegistry(Registry):
             "engine_requests": self._requests,
             "backend": jax.default_backend(),
             "hbm_committed_bytes": sum(self._hbm_committed.values()),
+            "hbm_ledger": self.hbm_ledger.last_sample(),
         }
         if self.mesh is not None:
             out["mesh"] = dict(self.mesh.shape)

@@ -286,10 +286,14 @@ class ClusterRouter:
             log.info("router: marked worker %s dead", worker_id)
 
     def members(self, *, live_only: bool = True) -> list[WorkerAdvert]:
+        """Live serving members. Gateway adverts (metrics-only, no chat
+        subjects) are excluded — they must not count as workers in healthz
+        or become steering candidates."""
         if not live_only:
             return list(self._members.values())
         cutoff = time.monotonic() - self.stale_after_s
-        return [m for m in self._members.values() if m.mono >= cutoff]
+        return [m for m in self._members.values()
+                if m.mono >= cutoff and m.role != "gateway"]
 
     # -- steering ------------------------------------------------------------
 

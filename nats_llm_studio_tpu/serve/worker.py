@@ -39,6 +39,7 @@ from ..obs import (
     Trace,
     Span,
     compile_cache_counts,
+    efficiency_enabled,
     install_compile_cache_listener,
     new_span_id,
     new_trace_id,
@@ -68,6 +69,21 @@ log = logging.getLogger(__name__)
 # subject (warm prefix-cache handoff, ISSUE 15); the Object Store
 # reference form carries the model inside its JSON body instead
 KV_MODEL_HEADER = "X-KV-Model"
+
+
+def _zip_dir(path: str) -> bytes:
+    """Zip a directory tree (relative paths) into an in-memory archive —
+    runs in a thread from on_profile; trace dirs are tens of MB at most."""
+    import io
+    import zipfile
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
 
 
 if hasattr(asyncio, "timeout"):
@@ -1144,6 +1160,11 @@ class Worker:
         except Exception as e:  # noqa: BLE001 — transfer failure must never fail the chat
             self._kv_transfer_failures += 1
             self._kv_transfer_ms["import"] += (time.monotonic() - t0) * 1000.0
+            # the local re-prefill below is duplicated device work (the peer
+            # already prefilled this prompt): tag the request so the batcher's
+            # device-time ledger charges its prefill ms to the disagg-fallback
+            # waste category instead of counting it as goodput
+            payload["_waste_tag"] = "disagg_fallback_reprefill"
             log.warning(
                 "kv prefetch from %s failed (%s: %s); serving with local prefill",
                 peer, type(e).__name__, e,
@@ -1528,6 +1549,28 @@ class Worker:
         r.gauge("lmstudio_mesh_tp", int(mesh.get("tp", 1)),
                 help="tensor-parallel width of the serving mesh "
                      "(1 = unsharded serving)")
+        # HBM ledger (obs/roofline.py, ticked by the flight recorder):
+        # priced-component sum vs the allocator's bytes_in_use. Guarded —
+        # test fakes implement stats() without the ledger key.
+        hbm = reg.get("hbm_ledger")
+        if efficiency_enabled() and isinstance(hbm, dict) and hbm:
+            r.gauge("lmstudio_hbm_bytes_in_use", hbm.get("bytes_in_use", 0),
+                    help="allocator bytes_in_use at the last ledger tick "
+                         "(0 on backends without memory_stats)")
+            r.gauge("lmstudio_hbm_priced_bytes", hbm.get("priced_bytes", 0),
+                    help="sum of priced HBM components (weights+pool, "
+                         "prefix cache, workspace slack)")
+            r.gauge("lmstudio_hbm_unexplained_bytes",
+                    hbm.get("unexplained_bytes", 0),
+                    help="bytes_in_use minus priced components")
+            r.gauge("lmstudio_hbm_drift_bytes", hbm.get("drift_bytes", 0),
+                    help="unexplained-bytes growth above the ledger baseline")
+        ledger = getattr(self.registry, "hbm_ledger", None)
+        if efficiency_enabled() and ledger is not None:
+            r.counter("lmstudio_hbm_drift_events_total",
+                      getattr(ledger, "drift_events", 0),
+                      help="hbm_drift events fired (unexplained bytes grew "
+                           "monotonically past the threshold)")
         r.gauge("lmstudio_events_emitted_total", EVENTS.emitted)
         # XLA persistent-compile-cache effectiveness (obs/compile_cache.py;
         # the listener is installed at worker start). Distinguishes "restart
@@ -1606,6 +1649,51 @@ class Worker:
                 for name, h in sorted(stats.program_token_histograms().items()):
                     r.histogram("lmstudio_program_tokens", h.snapshot(),
                                 labels={**labels, "program": name})
+            if efficiency_enabled() and hasattr(stats, "cost_counters"):
+                # compute-efficiency plane (obs/roofline.py): per-program
+                # roofline totals, rolling MFU/MBU split by program class
+                # (prefill is compute-bound → MFU headline; decode is
+                # bandwidth-bound → MBU headline), and the device-time
+                # ledger attributing every dispatch's ms to an outcome
+                flops, bytes_ = stats.cost_counters()
+                for name, v in sorted(flops.items()):
+                    r.counter("lmstudio_program_flops_total", v,
+                              labels={**labels, "program": name},
+                              help="XLA cost-analysis flops dispatched, "
+                                   "by program")
+                for name, v in sorted(bytes_.items()):
+                    r.counter("lmstudio_program_bytes_total", v,
+                              labels={**labels, "program": name},
+                              help="XLA cost-analysis bytes accessed, "
+                                   "by program")
+                util = stats.utilization()
+                for cls in ("prefill", "decode"):
+                    cl = {**labels, "class": cls}
+                    r.gauge("lmstudio_mfu", round(util[cls]["mfu"], 6),
+                            labels=cl,
+                            help="achieved / peak FLOP rate over a rolling "
+                                 "window, by program class")
+                    r.gauge("lmstudio_mbu", round(util[cls]["mbu"], 6),
+                            labels=cl,
+                            help="achieved / peak HBM bandwidth over a "
+                                 "rolling window, by program class")
+                dt = stats.device_time_snapshot()
+                for cat in sorted(dt["ms"]):
+                    cl = {**labels, "category": cat}
+                    r.counter("lmstudio_device_ms_total",
+                              round(dt["ms"][cat], 3), labels=cl,
+                              help="device-dispatch milliseconds attributed "
+                                   "to a request outcome category")
+                    r.counter("lmstudio_device_tokens_total",
+                              dt["tokens"].get(cat, 0), labels=cl,
+                              help="tokens delivered, by outcome category "
+                                   "of the device time that produced them")
+                r.gauge("lmstudio_goodput_tokens_per_device_s",
+                        round(stats.goodput_tokens_per_device_s(), 3),
+                        labels=labels,
+                        help="served tokens per device-second across ALL "
+                             "attributed device time (waste included in "
+                             "the denominator)")
             pool_stats_fn = getattr(eng.batcher, "pool_stats", None)
             pool = pool_stats_fn() if pool_stats_fn is not None else None
             if pool is not None:
@@ -1715,7 +1803,32 @@ class Worker:
                 jax.profiler.stop_trace()
         finally:
             self._profiling = False
-        await self._respond_ok(msg, {"trace_dir": trace_dir, "seconds": seconds})
+        reply: dict = {"trace_dir": trace_dir, "seconds": seconds}
+        # a profile captured via a directed subject on a REMOTE worker is
+        # useless as a local path: zip the trace and park it in the Object
+        # Store (same JetStream plumbing as kv-transfer) so the requester
+        # can pull it from anywhere. Best-effort — no JetStream on the
+        # broker (or any upload hiccup) keeps the local-path reply.
+        try:
+            blob = await asyncio.to_thread(_zip_dir, trace_dir)
+            digest = hashlib.sha256(blob).hexdigest()
+            from ..transport.jetstream import ObjectStore
+
+            assert self.nc is not None
+            # short API timeout: on a broker WITHOUT JetStream the $JS.API
+            # probe gets no responder and would otherwise stall the reply
+            # for the full window — the requester's own timeout loses first
+            store = ObjectStore(self.nc, timeout=5.0)
+            bucket = "profiles"
+            obj = f"{self.worker_id}-{digest[:16]}.zip"
+            await store.ensure_bucket(bucket)
+            await store.put(bucket, obj, blob)
+            reply.update(bucket=bucket, object=obj, sha256=digest,
+                         bytes=len(blob))
+        except Exception as e:  # noqa: BLE001 — upload is an optimization
+            log.warning("profile upload failed (%s: %s); trace stays local "
+                        "at %s", type(e).__name__, e, trace_dir)
+        await self._respond_ok(msg, reply)
 
     # -- deep-debug subjects (DEBUG_SUBJECTS=1 only) -------------------------
 
