@@ -55,9 +55,17 @@ class WorkerConfig:
     )
     max_batch_slots: int = field(default_factory=lambda: int(_env("MAX_BATCH_SLOTS", "8")))
     max_seq_len: int = field(default_factory=lambda: int(_env("MAX_SEQ_LEN", "4096")))
-    # "none" (serve in cfg dtype) or "int8" (weight-only per-channel int8:
-    # halves HBM weight traffic and fits 70B-class models on a v5e-8)
-    quant_mode: str = field(default_factory=lambda: _env("TPU_QUANT", "none"))
+    # weight-only quantization for serving: "none" (cfg dtype), "int8"
+    # (per-output-channel — halves HBM weight traffic, fits 70B-class
+    # models on a v5e-8) or "int4" (grouped asymmetric QTensor4,
+    # WQUANT_GROUP rows per scale/zero-point — halves it again). WQUANT is
+    # the documented knob; TPU_QUANT is honored as the legacy alias.
+    quant_mode: str = field(
+        default_factory=lambda: _env("WQUANT", "") or _env("TPU_QUANT", "none")
+    )
+    # rows of the contraction axis per int4 scale/zero-point pair (AWQ-style
+    # grouping; degrades automatically when it does not divide the axis)
+    wquant_group: int = field(default_factory=lambda: int(_env("WQUANT_GROUP", "32")))
     # "none" or "int8": quantized serving KV cache (ops/kvcache.py) — halves
     # decode cache traffic and per-slot HBM
     kv_quant_mode: str = field(default_factory=lambda: _env("TPU_KV_QUANT", "none"))

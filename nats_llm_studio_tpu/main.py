@@ -92,6 +92,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
     registry = LocalRegistry(
         store, mesh=mesh, max_seq_len=cfg.max_seq_len, max_batch_slots=cfg.max_batch_slots,
         quant=cfg.quant_mode, kv_quant=cfg.kv_quant_mode,
+        wquant_group=cfg.wquant_group,
         admit_queue_limit=cfg.admit_queue_limit, admit_max_age_ms=cfg.admit_max_age_ms,
         prefix_cache_blocks=cfg.prefix_cache_blocks,
         spec_decode_k=cfg.spec_decode_k, spec_max_active=cfg.spec_max_active,

@@ -381,6 +381,156 @@ def forward(
     return logits, k_cache, v_cache
 
 
+def _paged_attn_dispatch(q, k_pool, v_pool, tbl, pos, layer, scale: float, mesh):
+    """The Pallas paged-decode kernel, shard_mapped over tp when a mesh is
+    present (pallas_call is not GSPMD-partitionable, so the heads split is
+    explicit: q heads and pool heads shard on tp, tables/positions
+    replicate — the same layout pool_spec pins for the XLA path). The
+    batcher only routes here when Hkv % tp == 0 (the replicated-KV GQA
+    fallback stays on the XLA path)."""
+    from ..ops.paged_attention import paged_decode_attention_auto
+
+    tp = 0
+    if mesh is not None:
+        from ..parallel.mesh import AXIS_TP
+
+        tp = mesh.shape.get(AXIS_TP, 1)
+    if tp <= 1:
+        return paged_decode_attention_auto(q, k_pool, v_pool, tbl, pos, layer, scale)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_TP
+
+    qspec = P(None, None, AXIS_TP, None)
+    cspec = P(None, None, AXIS_TP, None, None)  # pool codes: heads at index 2
+    sspec = P(None, None, AXIS_TP, None)
+    rep2, rep1, rep0 = P(None, None), P(None), P()
+    if kv_is_quantized(k_pool):
+        def f(qh, kq, ks, vq, vs, tb, ps, ly):
+            return paged_decode_attention_auto(
+                qh, KVQ(q=kq, s=ks), KVQ(q=vq, s=vs), tb, ps, ly, scale
+            )
+
+        fn = shard_map(
+            f, mesh=mesh,
+            in_specs=(qspec, cspec, sspec, cspec, sspec, rep2, rep1, rep0),
+            out_specs=qspec, check_rep=False,
+        )
+        return fn(q, k_pool.q, k_pool.s, v_pool.q, v_pool.s, tbl, pos,
+                  jnp.asarray(layer, jnp.int32))
+
+    def g(qh, kp, vp, tb, ps, ly):
+        return paged_decode_attention_auto(qh, kp, vp, tb, ps, ly, scale)
+
+    fn = shard_map(
+        g, mesh=mesh,
+        in_specs=(qspec, cspec, cspec, rep2, rep1, rep0),
+        out_specs=qspec, check_rep=False,
+    )
+    return fn(q, k_pool, v_pool, tbl, pos, jnp.asarray(layer, jnp.int32))
+
+
+def forward_decode_paged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # int32 [B, W] — W == 1 decode, W == k+1 spec verify
+    k_pool,             # [NBp, L, Hkv, T, D] paged block pool (or KVQ pair)
+    v_pool,
+    tbl: jax.Array,     # [B, NB] int32 block table (NB static = max width)
+    start_pos: jax.Array,  # int32 [B] — tokens already in each slot's cache
+    mesh=None,
+) -> tuple[jax.Array, Any, Any]:
+    """Decode forward that reads/writes the paged pool DIRECTLY — no
+    ``kv_pool_gather_view`` materialization, no windowed attention, no
+    pow2-ladder recompiles (the attention grid spans the whole table width,
+    ops/paged_attention.py). Per layer: project q/k/v, rope at the slot's
+    positions, scatter the W fresh rows into the pool (quantize-on-write
+    under KVQ — identical codes to the view path's ``kv_update_slice``),
+    then run the Pallas kernel over the slot's entire paged history
+    (write-then-attend: the causal frontier includes the fresh rows).
+
+    Returns (logits [B, W, vocab] f32, k_pool, v_pool). Math mirrors
+    ``forward``'s positional path op-for-op outside the attention
+    accumulation order (online softmax vs dense), so greedy decode is
+    token-identical through the batcher."""
+    from ..ops.kvcache import kv_pool_write_rows
+
+    b, w = tokens.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    positions = start_pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype)) * cfg.embedding_scale
+
+    # TP_OVERLAP: the row-sharded projections' all-reduce runs as a
+    # ppermute ring (parallel/overlap.py) instead of one blocking psum —
+    # decode-only (this stack), default off, dense-FFN only (MoE keeps its
+    # own dispatch collectives)
+    overlap = False
+    if mesh is not None:
+        from ..parallel.mesh import AXIS_TP
+        from ..parallel.overlap import tp_overlap_enabled
+
+        overlap = (tp_overlap_enabled() and not cfg.is_moe
+                   and mesh.shape.get(AXIS_TP, 1) > 1
+                   and cfg.n_kv_heads % mesh.shape.get(AXIS_TP, 1) == 0)
+
+    def block_body(x, kp, vp, p, layer):
+        h = rms_norm(x, p["attn_norm"], cfg.rms_eps, cfg.norm_plus_one)
+        q = mm(h, p["wq"])
+        k = mm(h, p["wk"])
+        v = mm(h, p["wv"])
+        if cfg.attn_bias:
+            q = q + p["bq"]
+            k = k + p["bk"]
+            v = v + p["bv"]
+        q = apply_rope(q.reshape(b, w, hq, d), cos, sin)
+        k = apply_rope(k.reshape(b, w, hkv, d), cos, sin)
+        v = v.reshape(b, w, hkv, d)
+        kp = kv_pool_write_rows(kp, k, tbl, start_pos, layer)
+        vp = kv_pool_write_rows(vp, v, tbl, start_pos, layer)
+        out = _paged_attn_dispatch(q, kp, vp, tbl, start_pos, layer,
+                                   cfg.attn_scale, mesh)
+        attn_in = out.reshape(b, w, hq * d)
+        if overlap:
+            from ..parallel.overlap import overlap_row_proj
+
+            proj = overlap_row_proj(attn_in, p["wo"], mesh)
+        else:
+            proj = mm(attn_in, p["wo"])
+        x = x + proj * cfg.residual_scale
+        hh = rms_norm(x, p["ffn_norm"], cfg.rms_eps, cfg.norm_plus_one)
+        if cfg.is_moe:
+            if cfg.use_routed_moe:
+                from ..parallel.moe import routed_moe_ffn
+
+                ffn_out = routed_moe_ffn(hh, p, cfg, mesh, cfg.moe_capacity_factor)
+            else:
+                ffn_out = _moe_ffn(hh, p, cfg)
+        elif overlap:
+            from ..parallel.overlap import overlap_ffn
+
+            ffn_out = overlap_ffn(hh, p["w_gate"], p["w_up"], p["w_down"],
+                                  cfg.mlp_act, mesh)
+        else:
+            ffn_out = swiglu(hh, p["w_gate"], p["w_up"], p["w_down"], cfg.mlp_act)
+        x = x + ffn_out * cfg.residual_scale
+        return x, kp, vp
+
+    def block(carry, inputs):
+        x, kp, vp = carry
+        p, layer = inputs
+        return block_body(x, kp, vp, p, layer), None
+
+    layer_idx = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, k_pool, v_pool), _ = jax.lax.scan(
+        block, (x, k_pool, v_pool), (params["blocks"], layer_idx)
+    )
+    logits = lm_head_logits(params, cfg, x, None, w)
+    return logits, k_pool, v_pool
+
+
 def lm_head_logits(params: Params, cfg: ModelConfig, x: jax.Array,
                    logit_positions: jax.Array | None, t: int) -> jax.Array:
     """Shared output head (norm + lm_head, tied-embedding fallback,

@@ -80,12 +80,20 @@ DECODE_PROGRAMS = frozenset(
         "decode_pos_ext",
         "decode_pos_paged",
         "decode_pos_paged_ext",
+        # Pallas paged-decode kernel dispatches (serve/batcher.py under
+        # DECODE_KERNEL=pallas) — ledgered apart from the decode_pos_paged
+        # gather-view path so the roofline can attribute the kernel swap
+        "decode_pallas",
+        "decode_pallas_ext",
         "spec_verify",
         "spec_verify_paged",
+        "spec_verify_pallas",
     }
 )
 
-SPEC_PROGRAMS = frozenset({"spec_verify", "spec_verify_paged"})
+SPEC_PROGRAMS = frozenset(
+    {"spec_verify", "spec_verify_paged", "spec_verify_pallas"}
+)
 
 # Outcome categories for the device-time ledger.  "other" absorbs dispatches
 # with no request context (warmup, compaction, CoW copies).

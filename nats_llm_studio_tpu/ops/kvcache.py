@@ -239,6 +239,32 @@ def kv_pool_write_row(pool, row, bids):
     return KVQ(q=put(pool.q, row.q), s=put(pool.s, row.s))
 
 
+def kv_pool_write_rows(pool, rows, tbl, pos, layer):
+    """Scatter W fresh [Hkv, D] rows per slot straight into the pool at the
+    slot's logical positions pos..pos+W-1 (write-then-attend for the Pallas
+    paged-decode kernel, ops/paged_attention.py — no gather view exists on
+    that path, so fresh rows cannot ride a view scatter-back).
+
+    rows: [B, W, Hkv, D] raw activations (quantized on write under KVQ);
+    tbl: [B, NB] block ids; pos: [B] int32; layer: int32 scalar (traced).
+    Touched indices past a slot's table resolve to the null block (id 0);
+    duplicate junk writes there are benign (pool contract above).
+    """
+    w = rows.shape[1]
+    offs = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
+
+    def put(p, r):
+        t = p.shape[3]
+        vb = jnp.clip(offs // t, 0, tbl.shape[1] - 1)
+        bids = jnp.take_along_axis(tbl, vb, axis=1)  # [B, W]
+        return p.at[bids, layer, :, offs % t].set(r.astype(p.dtype))
+
+    if not is_quantized(pool):
+        return put(pool, rows)
+    rq = quantize_rows(rows)
+    return KVQ(q=put(pool.q, rq.q), s=put(pool.s, rq.s))
+
+
 def kv_pool_copy_block(pool, dst, src):
     """Copy-on-write: duplicate block ``src`` into ``dst`` (traced scalars)."""
 

@@ -24,7 +24,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.llama import _rope_deinterleave
-from ..ops.wquant import QTensor, quantizable, quantize_weight
+from ..ops.wquant import (
+    QTensor,
+    QTensor4,
+    quantizable,
+    quantize_weight,
+    quantize_weight4,
+)
 from .sharding import param_sharding_rules, scale_spec
 
 log = logging.getLogger(__name__)
@@ -41,7 +47,7 @@ def _place(arr: np.ndarray, mesh: Mesh, spec: P, dtype) -> jax.Array:
 
 def load_params_sharded(
     reader, cfg: ModelConfig, mesh: Mesh, dtype: str | None = None,
-    quant: str = "none",
+    quant: str = "none", group: int = 32,
 ) -> dict[str, Any]:
     """Build the stacked-params pytree directly on the mesh, one tensor at a
     time. Same tensor-name contract as models.llama.load_params_from_gguf.
@@ -49,10 +55,12 @@ def load_params_sharded(
     ``quant="int8"`` re-quantizes each matmul weight to symmetric
     per-output-channel int8 on the host *before* placement, so device HBM
     holds int8 + scales — the path that fits Llama-3-70B on a v5e-8
-    (BASELINE.md config 3) and halves decode weight traffic.
+    (BASELINE.md config 3) and halves decode weight traffic. ``quant="int4"``
+    goes further: asymmetric grouped QTensor4 (``group`` rows per
+    scale/zero-point), ~4.3 bits/weight, halving traffic again.
     """
     dt = jnp.dtype(dtype or cfg.dtype)
-    if quant not in ("none", "int8"):
+    if quant not in ("none", "int8", "int4"):
         raise ValueError(f"unknown quant mode {quant!r}")
     rules = param_sharding_rules(mesh, cfg)
 
@@ -63,7 +71,7 @@ def load_params_sharded(
         return np.ascontiguousarray(t(name).T)
 
     def place_leaf(key: str, arr: np.ndarray, spec: P, layered: bool):
-        """Host tensor -> device leaf (bf16 array or int8 QTensor)."""
+        """Host tensor -> device leaf (bf16 array or int8/int4 QTensor)."""
         w_sh = _layer_sharding(mesh, spec) if layered else NamedSharding(mesh, spec)
         if quant == "int8" and quantizable(key):
             qt = quantize_weight(arr)
@@ -71,6 +79,17 @@ def load_params_sharded(
             return QTensor(
                 q=jax.device_put(jnp.asarray(qt.q), w_sh),
                 s=jax.device_put(jnp.asarray(qt.s), NamedSharding(mesh, s_spec)),
+            )
+        if quant == "int4" and quantizable(key):
+            # codes AND grouped scales/zeros all keep the weight's spec
+            # (see shard_params: the grouped axis shards with the
+            # contraction axis, it is not extent-1 like the int8 scale)
+            qt = quantize_weight4(arr, group=group)
+            return QTensor4(
+                q=jax.device_put(jnp.asarray(qt.q), w_sh),
+                s=jax.device_put(jnp.asarray(qt.s), w_sh),
+                z=jax.device_put(jnp.asarray(qt.z), w_sh),
+                group=qt.group,
             )
         return jax.device_put(jnp.asarray(arr, dt), w_sh)
 
@@ -134,6 +153,14 @@ def load_params_sharded(
                                  NamedSharding(mesh, spec)),
                 s=jax.device_put(jnp.stack([s.s for s in slices]),
                                  NamedSharding(mesh, scale_spec(spec))),
+            )
+        elif isinstance(slices[0], QTensor4):
+            sh = NamedSharding(mesh, spec)
+            blocks[key] = QTensor4(
+                q=jax.device_put(jnp.stack([s.q for s in slices]), sh),
+                s=jax.device_put(jnp.stack([s.s for s in slices]), sh),
+                z=jax.device_put(jnp.stack([s.z for s in slices]), sh),
+                group=slices[0].group,
             )
         else:
             blocks[key] = jax.device_put(jnp.stack(slices), NamedSharding(mesh, spec))

@@ -69,12 +69,15 @@ def estimate_device_bytes(
     batch: int = 8,
     seq_len: int | None = None,
     cache_dtype_bytes: int | None = None,
+    group: int = 32,
 ) -> dict[str, int]:
     """Estimated peak HBM bytes per device: params + KV cache + workspace.
 
     ``mesh_shape`` e.g. {"tp": 8} or {"dp": 2, "ep": 4}. Sharded axes divide
     by the product of the tensor-parallel-like factors exactly as
     ``param_sharding_rules`` assigns them (tp for dense, ep x tp for experts).
+    ``quant="int4"`` prices grouped QTensor4 storage: half a byte per code
+    plus an f32 scale AND zero-point per ``group`` contraction rows.
     """
     dtype_bytes = 2 if cfg.dtype in ("bfloat16", "float16") else 4
     tp = mesh_shape.get("tp", 1)
@@ -102,6 +105,15 @@ def estimate_device_bytes(
             # scale: one f32 per output channel (last axis), same sharding
             scale_elems = n // leaf.shape[-2] if len(leaf.shape) >= 2 else 0
             params += w_bytes + scale_elems * 4
+        elif quant == "int4" and leaf.quantizable:
+            # packed nibbles: half a byte per code; scale + zero-point:
+            # one f32 pair per group of contraction rows (wquant degrades
+            # the group to divide small contraction axes — same here)
+            from ..ops.wquant import effective_group
+
+            g = effective_group(leaf.shape[-2], group)
+            meta_elems = (n // leaf.shape[-2]) * (leaf.shape[-2] // g)
+            params += n // 2 + meta_elems * 2 * 4
         else:
             params += n * dtype_bytes
 

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..ops.wquant import QTensor
+from ..ops.wquant import QTensor, QTensor4
 from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_SP, AXIS_TP
 
 
@@ -111,6 +111,19 @@ def shard_params(params: dict[str, Any], mesh: Mesh,
             return QTensor(
                 q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
                 s=jax.device_put(leaf.s, NamedSharding(mesh, scale_spec(spec))),
+            )
+        if isinstance(leaf, QTensor4):
+            # grouped int4: the packed codes [..., in/2, out] and the
+            # per-group scale/zero [..., in/group, out] all keep the
+            # weight's own spec — unlike the int8 scale (extent 1 on the
+            # contraction axis), the grouped axis has real extent and
+            # shards exactly as the contraction axis does
+            sh = NamedSharding(mesh, spec)
+            return QTensor4(
+                q=jax.device_put(leaf.q, sh),
+                s=jax.device_put(leaf.s, sh),
+                z=jax.device_put(leaf.z, sh),
+                group=leaf.group,
             )
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 

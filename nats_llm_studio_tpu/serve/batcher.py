@@ -34,7 +34,7 @@ import numpy as np
 
 from ..engine.generator import SamplingParams, default_buckets
 from ..models.config import ModelConfig
-from ..models.llama import forward, make_cache
+from ..models.llama import forward, forward_decode_paged, make_cache
 from ..engine.sampling import sample_rows, spec_accept_rows
 from ..obs import LogHistogram, Trace
 from ..obs import emit as obs_emit
@@ -214,6 +214,11 @@ class BatcherStats:
     # crash (the supervisor's restart path harvests this into the registry
     # accumulator behind lmstudio_inflight_failed_retryable_total)
     inflight_failed_retryable: int = 0
+    # first-seen (program, static-args) combos on the decode/verify paths —
+    # each one is a fresh XLA compile (the pow2 window ladder is the
+    # classic source; the Pallas decode kernel's whole-table grid keeps
+    # this flat). Exposed as lmstudio_decode_recompiles_total.
+    decode_recompiles: int = 0
     # speculative decoding (serve/spec.py): drafted = n-gram tokens sent to
     # verify dispatches, accepted = drafts the model's own distribution kept
     spec_verifies: int = 0  # width-(k+1) verify dispatches
@@ -411,6 +416,7 @@ class BatcherStats:
             "cancelled": self.cancelled,
             "shed": self.shed,
             "inflight_failed_retryable": self.inflight_failed_retryable,
+            "decode_recompiles": self.decode_recompiles,
         }
 
     def snapshot(self) -> dict:
@@ -431,6 +437,7 @@ class BatcherStats:
             "cancelled": self.cancelled,
             "shed": self.shed,
             "inflight_failed_retryable": self.inflight_failed_retryable,
+            "decode_recompiles": self.decode_recompiles,
             "spec_verifies": self.spec_verifies,
             "spec_drafted": self.spec_drafted,
             "spec_accepted": self.spec_accepted,
@@ -571,6 +578,25 @@ class ContinuousBatcher:
         else:
             self.kv_block_tokens = 0
             self.blocks_per_row = 0
+        # pow2 window-ladder cap: every distinct (program, window) pair on
+        # the XLA decode path is a fresh jit compile. Bounding the ladder to
+        # DECODE_LADDER_RUNGS rungs (max_seq halved rung-1 times, floor 8)
+        # caps compiles per program; short contexts just read a larger
+        # masked window (position masking keeps numerics identical).
+        rungs = max(1, int(os.environ.get("DECODE_LADDER_RUNGS", "6")))
+        f = max(8, self.max_seq >> (rungs - 1))
+        self._win_floor = 1 << max(0, f - 1).bit_length()
+        # first-seen static-arg combos per decode-path program (owner thread
+        # only) — the proxy behind stats.decode_recompiles
+        self._compiled_keys: set[tuple] = set()
+        # decode-kernel selection (ops/paged_attention.py): "pallas" streams
+        # pool blocks straight through each slot's table inside the
+        # attention kernel; "xla" is the gather-view fallback; "auto"
+        # (default) picks pallas only where Mosaic can tile the pool layout
+        # AND a real TPU backend is attached (off-TPU the kernel runs under
+        # the Pallas interpreter — right for equivalence tests, far too
+        # slow for serving).
+        self.decode_kernel = self._resolve_decode_kernel()
         # automatic prefix KV cache (serve/prefix_cache.py): chunk size IS
         # the (possibly halved) prefill chunk, so every cached block is a
         # boundary the chunked-prefill program can resume from. 0 = off,
@@ -1303,6 +1329,79 @@ class ContinuousBatcher:
                     pin_pool(kv_pool_copy_block(VP, dst, src)),
                 )
 
+            # -- Pallas paged-decode twins (ops/paged_attention.py) --------
+            # Same signatures and return contracts as the *_paged programs
+            # minus the ``nb`` static arg: the kernel's grid spans the WHOLE
+            # table, so one compile per burst width serves every context
+            # length — no gather-view materialization, no scatter-back, no
+            # pow2-ladder recompiles. Write-then-attend happens per layer
+            # inside forward_decode_paged (the pool is the only KV storage
+            # these programs touch).
+            fwd_paged = partial(forward_decode_paged, cfg=cfg, mesh=mesh)
+
+            @partial(jax.jit, donate_argnums=(2, 3), static_argnums=(11,))
+            def decode_pos_pallas(params, tok, KP, VP, tbl, pos, seeds,
+                                  steps, temp, topk, topp, n):
+                """Pallas decode burst: n single-token paged forwards in one
+                on-device scan, pool carried through."""
+                def body(carry, i):
+                    tok, KP, VP = carry
+                    logits, KP, VP = fwd_paged(
+                        params, tokens=tok[:, None], k_pool=KP, v_pool=VP,
+                        tbl=tbl, start_pos=pos + i,
+                    )
+                    nxt = sample_rows(
+                        logits[:, -1, :], seeds, steps + i, temp, topk, topp
+                    )
+                    return (nxt, KP, VP), nxt
+
+                (tok, KP, VP), toks = jax.lax.scan(
+                    body, (tok, KP, VP), jnp.arange(n, dtype=jnp.int32)
+                )
+                return (toks.T, pin_pool(KP), pin_pool(VP), tok, pos + n,
+                        steps + n)
+
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def decode_pos_pallas_ext(params, tok, KP, VP, tbl, pos, seeds,
+                                      steps, temp, topk, topp, mask):
+                """Pallas twin of decode_pos_paged_ext: one masked step with
+                logprob readback straight off the pool."""
+                logits, KP, VP = fwd_paged(
+                    params, tokens=tok[:, None], k_pool=KP, v_pool=VP,
+                    tbl=tbl, start_pos=pos,
+                )
+                raw = logits[:, -1, :]
+                nxt = sample_rows(raw, seeds, steps, temp, topk, topp,
+                                  mask=mask)
+                logp = jax.nn.log_softmax(raw.astype(jnp.float32), axis=-1)
+                chosen = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+                kk = min(LOGPROBS_K, raw.shape[-1])
+                top_lp, top_ids = jax.lax.top_k(logp, kk)
+                return (nxt, chosen, top_ids, top_lp, pin_pool(KP),
+                        pin_pool(VP), nxt, pos + 1, steps + 1)
+
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def spec_verify_pallas(params, tok, KP, VP, tbl, pos, drafts,
+                                   dlen, seeds, steps, temp, topk, topp):
+                """Pallas spec verify: the width-(k+1) draft bundle rides the
+                same kernel (W = k+1 query rows per slot) — rejected drafts'
+                pool rows are stale-by-position, overwritten by that slot's
+                next writes, exactly the positional-layout contract."""
+                toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+                logits, KP, VP = fwd_paged(
+                    params, tokens=toks_in, k_pool=KP, v_pool=VP,
+                    tbl=tbl, start_pos=pos,
+                )
+                out, n_emit = spec_accept_rows(
+                    logits, drafts, dlen, seeds, steps, temp, topk, topp
+                )
+                new_tok = jnp.take_along_axis(
+                    out, (n_emit - 1)[:, None], axis=1
+                )[:, 0]
+                width = toks_in.shape[1]
+                return (out, n_emit, pin_pool(KP), pin_pool(VP), new_tok,
+                        pos + n_emit, steps + width)
+
             self._sample_first = self._timed("sample_first", sample_first)
             self._admit_fused_paged = self._timed("admit_fused_paged", admit_fused_paged)
             self._admit_many_fused_paged = self._timed(
@@ -1319,6 +1418,13 @@ class ContinuousBatcher:
             )
             self._spec_verify_paged = self._timed("spec_verify_paged", spec_verify_paged)
             self._pool_copy_block = self._timed("pool_copy_block", pool_copy_block)
+            self._decode_pos_pallas = self._timed("decode_pallas", decode_pos_pallas)
+            self._decode_pos_pallas_ext = self._timed(
+                "decode_pallas_ext", decode_pos_pallas_ext
+            )
+            self._spec_verify_pallas = self._timed(
+                "spec_verify_pallas", spec_verify_pallas
+            )
 
         self._prefill1 = self._timed("prefill1", prefill1)
         self._prefill_full = self._timed("prefill_full", prefill_full)
@@ -1578,6 +1684,7 @@ class ContinuousBatcher:
             "max_slots": self.max_slots,
             "max_seq": self.max_seq,
             "paged": self.paged,
+            "decode_kernel": self.decode_kernel,
             "kv_block_tokens": self.kv_block_tokens,
             "queue_depth": self._wl_len + self._inbox.qsize(),
             "slots": slots,
@@ -2013,15 +2120,78 @@ class ContinuousBatcher:
                 return b
         return self.max_seq
 
+    def _resolve_decode_kernel(self) -> str:
+        """DECODE_KERNEL=pallas|xla|auto -> the kernel paged decode uses.
+
+        "pallas" is honored only where the shard_map heads split works
+        (Hkv % tp == 0 — the replicated-KV GQA fallback stays on the XLA
+        path) and, on a real TPU, where Mosaic can tile the pool layout
+        (``paged_decode_eligible``); anything else downshifts with a log
+        line. "auto" additionally requires the TPU backend: off-TPU the
+        kernel only runs under the Pallas interpreter, which is what the
+        equivalence tests want and what serving throughput does not."""
+        if not self.paged:
+            return "xla"
+        mode = os.environ.get("DECODE_KERNEL", "auto").strip().lower() or "auto"
+        if mode not in ("pallas", "xla", "auto"):
+            raise ValueError(
+                f"DECODE_KERNEL must be pallas|xla|auto, got {mode!r}"
+            )
+        if mode == "xla":
+            return "xla"
+        from ..ops.paged_attention import paged_decode_eligible
+
+        cfg = self.cfg
+        tp = 1
+        if self.mesh is not None:
+            from ..parallel.mesh import AXIS_TP
+
+            tp = self.mesh.shape.get(AXIS_TP, 1)
+        if tp > 1 and cfg.n_kv_heads % tp:
+            if mode == "pallas":
+                log.warning(
+                    "DECODE_KERNEL=pallas needs Hkv %% tp == 0 (have "
+                    "Hkv=%d, tp=%d); falling back to xla",
+                    cfg.n_kv_heads, tp,
+                )
+            return "xla"
+        on_tpu = jax.default_backend() == "tpu"
+        eligible = paged_decode_eligible(
+            self.kv_block_tokens, cfg.head_dim,
+            4 if cfg.dtype == "float32" else 2,
+            cfg.kv_quant == "int8", cfg.n_kv_heads, tp,
+        )
+        if mode == "auto":
+            return "pallas" if (on_tpu and eligible) else "xla"
+        if on_tpu and not eligible:
+            log.warning(
+                "DECODE_KERNEL=pallas but the pool layout (T=%d, D=%d, "
+                "kv_quant=%s) is not Mosaic-tileable; falling back to xla",
+                self.kv_block_tokens, cfg.head_dim, cfg.kv_quant,
+            )
+            return "xla"
+        return "pallas"
+
+    def _note_compile(self, program: str, *static) -> None:
+        """Count first-seen static-arg combos on the decode/verify paths —
+        each is a fresh XLA compile (owner thread only). The counter makes
+        the pow2 ladder's compile cost visible next to the Pallas kernel's
+        flat one (lmstudio_decode_recompiles_total)."""
+        key = (program, *static)
+        if key not in self._compiled_keys:
+            self._compiled_keys.add(key)
+            self.stats.decode_recompiles += 1
+
     def _win_bucket(self, n: int) -> int:
         """Power-of-two attention window >= n, clamped to max_seq — the
         chunked-prefill read bound. Independent of the (often coarse)
         prompt-length buckets: with buckets like [512, 2048, 16k] a
         bucket-based window reads the full 16k slab from chunk 3 on
         (exactly the r4 O(T^2) tail), while the pow2 ladder keeps reads
-        proportional to the live prefix at a log-bounded compile count."""
+        proportional to the live prefix at a log-bounded compile count.
+        The floor caps the ladder at DECODE_LADDER_RUNGS rungs total."""
         w = 1 << max(0, n - 1).bit_length()
-        return min(w, self.max_seq)
+        return min(max(w, self._win_floor), self.max_seq)
 
     def _run(self) -> None:
         cfg = self.cfg
@@ -2032,6 +2202,7 @@ class ContinuousBatcher:
         # to 0 so admitted prefixes land at sequence positions [0, n)
         spec = self.spec_cfg
         paged = self.paged
+        use_pallas = paged and self.decode_kernel == "pallas"
         pool = self._pool
         T = self.kv_block_tokens
         MB = self.blocks_per_row
@@ -2583,20 +2754,32 @@ class ContinuousBatcher:
                     ensure_blocks(i, min(host_pos[i] + n, self.max_seq))
                     ensure_private(i, host_pos[i], host_pos[i] + n)
                 refresh_tables()
-                nb = paged_window(max(host_pos[i] for i in act) + n + 1)
-                toks, K, V, tok_dev, pos_dev, steps_dev = (
-                    self._decode_pos_paged(
-                        self.params, tok_dev, K, V, tbl_dev, pos_dev,
-                        seeds_dev, steps_dev, temp, topk, topp, n, nb,
-                        _tokens=len(act) * n,
+                if use_pallas:
+                    self._note_compile("decode_pallas", n)
+                    toks, K, V, tok_dev, pos_dev, steps_dev = (
+                        self._decode_pos_pallas(
+                            self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                            seeds_dev, steps_dev, temp, topk, topp, n,
+                            _tokens=len(act) * n,
+                        )
                     )
-                )
+                else:
+                    nb = paged_window(max(host_pos[i] for i in act) + n + 1)
+                    self._note_compile("decode_pos_paged", n, nb)
+                    toks, K, V, tok_dev, pos_dev, steps_dev = (
+                        self._decode_pos_paged(
+                            self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                            seeds_dev, steps_dev, temp, topk, topp, n, nb,
+                            _tokens=len(act) * n,
+                        )
+                    )
             elif positional:
                 # writes land at each row's own position: the window only
                 # needs to cover the highest live position after the burst
                 # (pow2 ladder, same bounded-compile argument as prefill)
                 w = self._win_bucket(max(host_pos[i] for i in act) + n + 1)
                 window = w if w < self.max_seq else None
+                self._note_compile("decode_pos", n, window)
                 toks, K, V, tok_dev, pos_dev, steps_dev = self._decode_pos(
                     self.params, tok_dev, K, V, pos_dev,
                     seeds_dev, steps_dev, temp, topk, topp, n, window,
@@ -2611,6 +2794,7 @@ class ContinuousBatcher:
                     w = self._bucket(self._ring_next + n)
                     if w < self.max_seq:
                         window = w
+                self._note_compile("decode", n, window)
                 toks, K, V, tok_dev, pos_dev, steps_dev = self._decode(
                     self.params, tok_dev, K, V, pos_dev, jnp.int32(self._ring_next),
                     seeds_dev, steps_dev, temp, topk, topp, n, window,
@@ -2659,16 +2843,27 @@ class ContinuousBatcher:
                     ensure_blocks(i, min(host_pos[i] + 1, self.max_seq))
                     ensure_private(i, host_pos[i], host_pos[i] + 1)
                 refresh_tables()
-                nb = paged_window(max(host_pos[i] for i in act) + 2)
-                (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
-                 steps_dev) = self._decode_pos_paged_ext(
-                    self.params, tok_dev, K, V, tbl_dev, pos_dev,
-                    seeds_dev, steps_dev, temp, topk, topp, mask_dev, nb,
-                    _tokens=len(act),
-                )
+                if use_pallas:
+                    self._note_compile("decode_pallas_ext")
+                    (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
+                     steps_dev) = self._decode_pos_pallas_ext(
+                        self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                        seeds_dev, steps_dev, temp, topk, topp, mask_dev,
+                        _tokens=len(act),
+                    )
+                else:
+                    nb = paged_window(max(host_pos[i] for i in act) + 2)
+                    self._note_compile("decode_pos_paged_ext", nb)
+                    (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
+                     steps_dev) = self._decode_pos_paged_ext(
+                        self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                        seeds_dev, steps_dev, temp, topk, topp, mask_dev, nb,
+                        _tokens=len(act),
+                    )
             else:
                 w = self._win_bucket(max(host_pos[i] for i in act) + 2)
                 window = w if w < self.max_seq else None
+                self._note_compile("decode_pos_ext", window)
                 (toks, lps, top_ids, top_lps, K, V, tok_dev, pos_dev,
                  steps_dev) = self._decode_pos_ext(
                     self.params, tok_dev, K, V, pos_dev,
@@ -2727,18 +2922,31 @@ class ContinuousBatcher:
                     ensure_blocks(i, min(host_pos[i] + kspec + 1, self.max_seq))
                     ensure_private(i, host_pos[i], host_pos[i] + kspec + 1)
                 refresh_tables()
-                nb = paged_window(max(host_pos[i] for i in act) + kspec + 1)
-                out, nacc, K, V, tok_dev, pos_dev, steps_dev = (
-                    self._spec_verify_paged(
-                        self.params, tok_dev, K, V, tbl_dev, pos_dev,
-                        jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
-                        seeds_dev, steps_dev, temp, topk, topp, nb,
-                        _tokens=len(act) * (kspec + 1),
+                if use_pallas:
+                    self._note_compile("spec_verify_pallas", kspec)
+                    out, nacc, K, V, tok_dev, pos_dev, steps_dev = (
+                        self._spec_verify_pallas(
+                            self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                            jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
+                            seeds_dev, steps_dev, temp, topk, topp,
+                            _tokens=len(act) * (kspec + 1),
+                        )
                     )
-                )
+                else:
+                    nb = paged_window(max(host_pos[i] for i in act) + kspec + 1)
+                    self._note_compile("spec_verify_paged", nb)
+                    out, nacc, K, V, tok_dev, pos_dev, steps_dev = (
+                        self._spec_verify_paged(
+                            self.params, tok_dev, K, V, tbl_dev, pos_dev,
+                            jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),
+                            seeds_dev, steps_dev, temp, topk, topp, nb,
+                            _tokens=len(act) * (kspec + 1),
+                        )
+                    )
             else:
                 w = self._win_bucket(max(host_pos[i] for i in act) + kspec + 1)
                 window = w if w < self.max_seq else None
+                self._note_compile("spec_verify", window)
                 out, nacc, K, V, tok_dev, pos_dev, steps_dev = self._spec_verify(
                     self.params, tok_dev, K, V, pos_dev,
                     jnp.asarray(drafts), jnp.asarray(dlens, jnp.int32),

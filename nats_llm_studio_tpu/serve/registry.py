@@ -620,6 +620,7 @@ class LocalRegistry(Registry):
         max_batch_slots: int = 8,
         quant: str = "none",
         kv_quant: str = "none",
+        wquant_group: int = 32,
         admit_queue_limit: int = 0,
         admit_max_age_ms: float = 0.0,
         prefix_cache_blocks: int | None = None,
@@ -647,6 +648,8 @@ class LocalRegistry(Registry):
         self.max_seq_len = max_seq_len
         self.max_batch_slots = max_batch_slots
         self.quant = quant
+        # rows per int4 scale/zero-point group (only read when quant="int4")
+        self.wquant_group = wquant_group
         # "int8": store the serving KV cache quantized (ops/kvcache.py) —
         # halves decode cache traffic and per-slot HBM, so the same chip
         # serves ~2x the concurrent slots
@@ -1052,6 +1055,7 @@ class LocalRegistry(Registry):
         est = estimate_device_bytes(
             cfg, mesh_shape, quant=self.quant, batch=self.max_batch_slots,
             seq_len=seq, cache_dtype_bytes=1 if self.kv_quant == "int8" else None,
+            group=self.wquant_group,
         )
         if not self.kv_paged:
             return est["total"]
@@ -1216,12 +1220,17 @@ class LocalRegistry(Registry):
             from ..parallel.loader import load_params_sharded
 
             validate_mesh_for_config(self.mesh, cfg)
-            params = load_params_sharded(reader, cfg, self.mesh, quant=self.quant)
-        elif self.quant == "int8":
+            params = load_params_sharded(
+                reader, cfg, self.mesh, quant=self.quant, group=self.wquant_group
+            )
+        elif self.quant in ("int8", "int4"):
             from ..models.llama import ensure_lm_head
             from ..ops.wquant import quantize_params
 
-            params = quantize_params(ensure_lm_head(load_params_from_gguf(reader, cfg)))
+            params = quantize_params(
+                ensure_lm_head(load_params_from_gguf(reader, cfg)),
+                mode=self.quant, group=self.wquant_group,
+            )
         else:
             from ..models.llama import ensure_lm_head
 

@@ -1629,6 +1629,18 @@ class Worker:
             r.gauge("lmstudio_brownout_level",
                     getattr(eng.batcher, "brownout_level", 0), labels=labels,
                     help="0=normal 1=brownout 2=shed-only")
+            # decode-kernel family: which kernel serves paged decode and how
+            # many fresh decode-program compiles the window ladder has cost
+            # (flat under DECODE_KERNEL=pallas — its grid is context-length
+            # independent)
+            r.counter("lmstudio_decode_recompiles_total",
+                      getattr(stats, "decode_recompiles", 0), labels=labels,
+                      help="first-seen (program, static-args) combos on the "
+                           "decode/verify paths — each is a fresh XLA compile")
+            r.gauge("lmstudio_decode_kernel_pallas",
+                    1 if getattr(eng.batcher, "decode_kernel", "xla") == "pallas"
+                    else 0, labels=labels,
+                    help="1 when the Pallas paged-decode kernel is serving")
             if hasattr(stats, "spec_counters"):
                 # speculative decoding: lmstudio_spec_{verifies,drafted,
                 # accepted}_total; the lmstudio_spec_accept_rate histogram
