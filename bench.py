@@ -1873,6 +1873,177 @@ def tensor_parallel_bench(cfg, params, model_id: str, *, seq: int | None = None,
 
 
 # ---------------------------------------------------------------------------
+# multi-axis serving mesh: dp replicas / routed MoE / sp ring prefill
+# ---------------------------------------------------------------------------
+
+
+def multi_axis_bench(cfg, params, model_id: str, *, seq: int | None = None,
+                     slots: int | None = None, n_reqs: int | None = None,
+                     max_new: int | None = None) -> dict:
+    """The three axes the named mesh adds beyond tp, each measured through
+    the LIVE serving path: (a) dp=2 batcher replicas vs one dp=1 replica —
+    aggregate tok/s for the same closed wave plus the per-replica request
+    split; (b) routed (capacity-factor) vs dense-dispatch MoE — prefill
+    wall for a prompt-heavy wave on the same weights; (c) sp=2 ring
+    prefill on vs off — long-prompt TTFT. Skipped on one device."""
+    import asyncio
+
+    from nats_llm_studio_tpu.parallel import build_mesh, dp_submeshes
+    from nats_llm_studio_tpu.parallel.sharding import shard_params
+    from nats_llm_studio_tpu.serve.batcher import ContinuousBatcher
+    from nats_llm_studio_tpu.serve.dp import DataParallelBatcher
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": "single device — no dp/sp axis to bench"}
+    tokenizer = _make_bench_tokenizer(cfg)
+    seq = seq or int(os.environ.get("BENCH_MA_SEQ", "256"))
+    slots = slots or int(os.environ.get("BENCH_MA_SLOTS", "4"))
+    n_reqs = n_reqs or int(os.environ.get("BENCH_MA_REQS", "8"))
+    max_new = max_new or int(os.environ.get("BENCH_MA_NEW", "16"))
+    buckets = [b for b in (64,) if b < seq] + [seq]
+
+    def wave(batcher, prompts, new, replicas=None, wcfg=None, wtok=None,
+             mid=None):
+        """Closed wave through the broker+worker path: wall, aggregate
+        tok/s, TTFT p50 — plus the per-replica request split (wave only,
+        warm excluded) when ``replicas`` is given. ``wcfg``/``wtok``/``mid``
+        override the engine config for the MoE sub-phase."""
+        nrep = len(replicas) if replicas else 1
+
+        async def body(nc, one_chat):
+            # compiles must not land inside the wall: warm the singleton
+            # admit and the group widths the wave coalesces into. A dp
+            # facade spreads a burst least-loaded, so widths are scaled by
+            # the replica count (each replica sees a w-wide group) and a
+            # second singleton round reaches the sibling replica's grid
+            for r_ in range(nrep):
+                await one_chat(900 + r_, prompts[0], 4)
+            w = 2
+            while w <= min(batcher.max_group_admit, len(prompts), slots):
+                await asyncio.gather(
+                    *(one_chat(910 + w + i, prompts[0], 4)
+                      for i in range(w * nrep))
+                )
+                w *= 2
+            await asyncio.sleep(0.3)
+            pre = ([r.stats.snapshot().get("requests", 0) for r in replicas]
+                   if replicas else None)
+            t0 = time.perf_counter()
+            reqs = await asyncio.gather(
+                *(one_chat(1000 + i, p, new) for i, p in enumerate(prompts))
+            )
+            wall = time.perf_counter() - t0
+            toks = sum(r["completion_tokens"] for r in reqs)
+            ttfts = sorted(r["ttft_s"] * 1e3 for r in reqs
+                           if r["ttft_s"] == r["ttft_s"])
+            res = {
+                "wall_s": round(wall, 3),
+                "tok_s": round(toks / wall, 1) if wall else 0.0,
+                "ttft_p50_ms": round(_pctl(ttfts, 0.5), 1),
+                "requests": len(prompts),
+            }
+            if pre is not None:
+                res["replica_requests"] = [
+                    r.stats.snapshot().get("requests", 0) - p0
+                    for r, p0 in zip(replicas, pre)
+                ]
+            return res
+
+        return _drive_engine(wcfg or cfg, params, mid or model_id,
+                             wtok or tokenizer, batcher, body)
+
+    out: dict = {"devices": len(devices)}
+    short = [f"{SHORT_PROMPT} [{i}]" for i in range(n_reqs)]
+
+    # -- (a) dp replicas: aggregate tok/s, dp=2 vs dp=1 ---------------------
+    mesh = build_mesh("dp=2", devices=devices[:2])
+    reps = [
+        ContinuousBatcher(shard_params(params, s, cfg), cfg, max_slots=slots,
+                          max_seq_len=seq, buckets=buckets, mesh=s)
+        for s in dp_submeshes(mesh)
+    ]
+    dpb = DataParallelBatcher(reps)
+    dpb.start()  # registry._load starts engines eagerly; mirror it so the
+    # worker supervisor never reads a not-yet-started replica as crashed
+    on = wave(dpb, short, max_new, replicas=reps)
+    del dpb, reps
+    gc.collect()
+    single = ContinuousBatcher(params, cfg, max_slots=slots, max_seq_len=seq,
+                               buckets=buckets, mesh=None)
+    off = wave(single, short, max_new)
+    del single
+    gc.collect()
+    out["dp"] = {
+        "dp2": on, "dp1": off,
+        "aggregate_speedup": (round(on["tok_s"] / off["tok_s"], 2)
+                              if off.get("tok_s") else 0.0),
+    }
+
+    # -- (b) routed vs dense MoE dispatch: prefill-heavy wave ---------------
+    moe_kw = dict(n_layers=2, n_experts=8, n_experts_used=2, d_ff=32,
+                  max_seq_len=seq, moe_capacity_factor=2.0)
+    moe_routed = ModelConfig.tiny(use_routed_moe=True, **moe_kw)
+    moe_dense = ModelConfig.tiny(use_routed_moe=False, **moe_kw)
+    moe_params = init_params(moe_routed, jax.random.PRNGKey(3))
+    # byte tokenizer: 1 char = 1 token, so this is a prefill-dominated wave
+    moe_prompts = ["m" * (seq // 2) + str(i) for i in range(4)]
+
+    def moe_wave(mcfg):
+        b = ContinuousBatcher(moe_params, mcfg, max_slots=slots,
+                              max_seq_len=seq, buckets=buckets, mesh=None)
+        r = wave(b, moe_prompts, 2, wcfg=mcfg,
+                 wtok=_make_bench_tokenizer(mcfg), mid="bench/moe")
+        del b
+        gc.collect()
+        return r
+
+    routed = moe_wave(moe_routed)
+    dense = moe_wave(moe_dense)
+    out["moe"] = {
+        "routed": routed, "dense": dense,
+        "prefill_speedup": (
+            round(dense["wall_s"] / routed["wall_s"], 2)
+            if routed.get("wall_s") else 0.0
+        ),
+    }
+
+    # -- (c) sp ring prefill on vs off: long-prompt TTFT --------------------
+    long_prompts = ["l" * (seq // 2 + i) for i in range(4)]
+    saved_env = os.environ.get("RING_PREFILL_MIN_TOKENS")
+    try:
+        os.environ["RING_PREFILL_MIN_TOKENS"] = str(seq // 4)
+        sp_mesh = build_mesh("sp=2", devices=devices[:2])
+        b = ContinuousBatcher(shard_params(params, sp_mesh, cfg), cfg,
+                              max_slots=slots, max_seq_len=seq,
+                              buckets=buckets, mesh=sp_mesh)
+        sp_on = wave(b, long_prompts, 4)
+        hists = set(b.stats.program_histograms())
+        sp_on["ring_programs"] = sorted(
+            n for n in hists if n.endswith("_ring"))
+        del b
+        gc.collect()
+    finally:
+        if saved_env is None:
+            os.environ.pop("RING_PREFILL_MIN_TOKENS", None)
+        else:
+            os.environ["RING_PREFILL_MIN_TOKENS"] = saved_env
+    b = ContinuousBatcher(params, cfg, max_slots=slots, max_seq_len=seq,
+                          buckets=buckets, mesh=None)
+    sp_off = wave(b, long_prompts, 4)
+    del b
+    gc.collect()
+    out["sp"] = {
+        "sp2_ring": sp_on, "sp_off": sp_off,
+        "long_prefill_wall_ratio": (
+            round(sp_off["wall_s"] / sp_on["wall_s"], 2)
+            if sp_on.get("wall_s") else 0.0
+        ),
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
 # observability overhead: flight recorder on vs off
 # ---------------------------------------------------------------------------
 
@@ -3508,6 +3679,14 @@ def main() -> None:
                            cfg, params, "bench/tiny",
                            seq=128, slots=4, n_reqs=4, max_new=16,
                        ))
+        if os.environ.get("BENCH_MULTI_AXIS", "1") != "0":
+            # micro-run of the multi-axis mesh phase: dp=2 replica aggregate
+            # vs dp=1, routed-vs-dense MoE prefill, sp ring on/off — only
+            # meaningful under forced host devices, skips on one device
+            _run_phase(tiny_detail, "multi_axis", lambda: multi_axis_bench(
+                cfg, params, "bench/tiny",
+                seq=128, slots=2, n_reqs=4, max_new=8,
+            ))
         if os.environ.get("BENCH_OBS", "1") != "0":
             # micro-run of the recorder-overhead phase: on CPU smoke the
             # noise-floor guard does the work; TPU runs get the real 1% bound
@@ -3671,6 +3850,13 @@ def main() -> None:
     # -- tensor-parallel serving: tp=1 vs tp=N on the same engine ------------
     if os.environ.get("BENCH_TP", "1") != "0":
         _run_phase(detail, "tensor_parallel", lambda: tensor_parallel_bench(
+            cfg, params, "bench/llama3-8b"
+        ))
+        gc.collect()
+
+    # -- multi-axis mesh: dp replicas / routed MoE / sp ring prefill ---------
+    if os.environ.get("BENCH_MULTI_AXIS", "1") != "0":
+        _run_phase(detail, "multi_axis", lambda: multi_axis_bench(
             cfg, params, "bench/llama3-8b"
         ))
         gc.collect()

@@ -39,9 +39,12 @@ class WorkerConfig:
     # serving mesh spec (parallel.mesh.serving_mesh): "auto" (default)
     # shards every local device on the tp axis — tensor-parallel serving
     # is the multi-device default; a single-device host serves unsharded.
-    # "off"/"none"/"1" force tp=1; explicit specs like "tp=4" or
-    # "dp=2,tp=4" build exactly that mesh. MESH_SHAPE is the documented
-    # knob; TPU_MESH is honored as the legacy alias.
+    # "off"/"none"/"1" force tp=1; explicit specs like "tp=4",
+    # "dp=2,tp=4", or the compact named-axis grammar "dp2,ep2,tp2" build
+    # exactly that mesh. dp = independent batcher replicas (multiplied
+    # slot capacity), ep = MoE expert sharding, sp = ring-attention
+    # long-prompt prefill (RING_PREFILL_MIN_TOKENS). MESH_SHAPE is the
+    # documented knob; TPU_MESH is honored as the legacy alias.
     mesh_shape: str = field(
         default_factory=lambda: _env("MESH_SHAPE", "") or _env("TPU_MESH", "auto")
     )

@@ -179,9 +179,12 @@ def _attention_block(
 
     sp_ring = False
     if mesh is not None and t > 1:
-        from ..parallel.mesh import AXIS_SP
+        # long prompts only (RING_PREFILL_MIN_TOKENS): t is static under
+        # jit, so each prefill bucket's program bakes its own ring-vs-dense
+        # decision and short prompts keep the single-chip prefill lane
+        from ..parallel.ring_attention import use_ring_prefill
 
-        sp_ring = AXIS_SP in mesh.axis_names and mesh.shape[AXIS_SP] > 1
+        sp_ring = use_ring_prefill(mesh, t)
 
     if t > 1 and (sp_ring or (cfg.use_flash_attention and allow_flash)):
         # prefill at start_pos 0: the cache holds exactly k/v, so causal

@@ -36,6 +36,8 @@ __all__ = [
     "SPEC_PROGRAMS",
     "WASTE_CATEGORIES",
     "classify_program",
+    "program_base",
+    "program_family",
     "efficiency_enabled",
     "chip_peaks",
     "resolve_chip_peaks",
@@ -108,7 +110,41 @@ WASTE_CATEGORIES = (
 )
 
 
+# Program-family suffixes the batcher appends to the base dispatch names:
+# ``_moe`` when the forward runs capacity-factor routed MoE (appended at
+# wrap time — a property of the model), ``_ring`` when a prefill_full
+# dispatch takes the sp ring-attention path (appended per dispatch — a
+# property of that prompt's length bucket).  Classification strips them so
+# the roofline ledger keeps one prefill/decode split while metrics retain
+# the tagged names.
+_FAMILY_SUFFIXES = ("_ring", "_moe")
+
+
+def program_base(name: str) -> str:
+    """Strip family suffixes: ``prefill_full_moe_ring`` -> ``prefill_full``."""
+    changed = True
+    while changed:
+        changed = False
+        for sfx in _FAMILY_SUFFIXES:
+            if name.endswith(sfx) and name[: -len(sfx)]:
+                name = name[: -len(sfx)]
+                changed = True
+    return name
+
+
+def program_family(name: str) -> str:
+    """Coarse family tag for a recorded program name: ``ring_prefill`` when
+    the dispatch ran the sequence-parallel ring, ``moe_routed`` when the
+    forward used routed experts, ``dense`` otherwise."""
+    if name.endswith("_ring") or "_ring_" in name:
+        return "ring_prefill"
+    if name.endswith("_moe") or "_moe_" in name:
+        return "moe_routed"
+    return "dense"
+
+
 def classify_program(name: str) -> str:
+    name = program_base(name)
     if name in PREFILL_PROGRAMS:
         return "prefill"
     if name in DECODE_PROGRAMS:

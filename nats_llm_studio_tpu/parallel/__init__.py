@@ -14,6 +14,7 @@ from .mesh import (
     AXIS_SP,
     AXIS_TP,
     build_mesh,
+    dp_submeshes,
     parse_mesh_spec,
     serving_mesh,
 )
@@ -26,6 +27,7 @@ __all__ = [
     "AXIS_EP",
     "AXIS_SP",
     "build_mesh",
+    "dp_submeshes",
     "parse_mesh_spec",
     "serving_mesh",
     "param_sharding_rules",

@@ -76,13 +76,16 @@ def estimate_device_bytes(
     ``mesh_shape`` e.g. {"tp": 8} or {"dp": 2, "ep": 4}. Sharded axes divide
     by the product of the tensor-parallel-like factors exactly as
     ``param_sharding_rules`` assigns them (tp for dense, ep x tp for experts).
-    ``quant="int4"`` prices grouped QTensor4 storage: half a byte per code
-    plus an f32 scale AND zero-point per ``group`` contraction rows.
+    A dp factor does NOT divide anything: dp serves as independent batcher
+    replicas on disjoint device slices, so each device sees one replica's
+    full weights-and-cache footprint — per-chip bytes at ``dp=2,tp=2``
+    equal ``tp=2``. ``quant="int4"`` prices grouped QTensor4 storage: half
+    a byte per code plus an f32 scale AND zero-point per ``group``
+    contraction rows.
     """
     dtype_bytes = 2 if cfg.dtype in ("bfloat16", "float16") else 4
     tp = mesh_shape.get("tp", 1)
     ep = mesh_shape.get("ep", 1)
-    dp = mesh_shape.get("dp", 1)
     seq = seq_len or cfg.max_seq_len
     # replicated-KV GQA fallback (sharding.kv_replicated): when tp cannot
     # divide the KV heads, wk/wv/bk/bv and the cache stay whole per chip
@@ -119,7 +122,11 @@ def estimate_device_bytes(
 
     cb = cache_dtype_bytes or dtype_bytes
     kv = 2 * cfg.n_layers * batch * seq * cfg.n_kv_heads * cfg.head_dim * cb
-    kv //= dp * kv_tp  # batch on dp, kv heads on tp (unless replicated)
+    # dp is served as independent batcher REPLICAS over disjoint device
+    # slices (mesh.dp_submeshes): each replica holds its own full-``batch``
+    # cache, so per-DEVICE kv bytes do not divide by dp — only the kv-head
+    # tp sharding (unless replicated) shrinks them
+    kv //= kv_tp
 
     # workspace: logits [B, V] f32 (vocab sharded on tp) + activations
     # [B, T, d]-scale temporaries + collective buffers; a conservative pad

@@ -2,8 +2,14 @@
 
 Axis vocabulary: ``dp`` (data/batch), ``pp`` (pipeline: layer stages), ``tp``
 (tensor: heads + MLP), ``ep`` (experts), ``sp`` (sequence/context — ring
-attention). A spec is ``"tp=8"`` or ``"dp=2,tp=4"``; ``"auto"``/empty uses
-all local devices on tp.
+attention). A spec is ``"tp=8"`` or ``"dp=2,tp=4"`` — the compact named-axis
+grammar ``"dp2,ep2,tp2"`` is accepted as the same thing; ``"auto"``/empty
+uses all local devices on tp.
+
+Multi-axis serving (the dp/ep/sp axes in the live serving path): ``dp``
+splits the mesh into independent batcher replicas (``dp_submeshes``), ``ep``
+shards MoE expert stacks, ``sp`` enables ring-attention sequence-parallel
+prefill for long prompts (``RING_PREFILL_MIN_TOKENS``).
 
 Multi-host: when ``jax.distributed.initialize`` has run, ``jax.devices()``
 spans hosts and the same specs build DCN-crossing meshes; keep dp outermost
@@ -28,13 +34,24 @@ _KNOWN = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 def parse_mesh_spec(spec: str) -> dict[str, int]:
-    """``"dp=2,tp=4"`` -> {"dp": 2, "tp": 4} (order normalized dp,ep,sp,tp)."""
+    """``"dp=2,tp=4"`` -> {"dp": 2, "tp": 4} (order normalized dp,ep,sp,tp).
+
+    The compact named-axis grammar ``"dp2,ep2,tp2"`` (no ``=``) parses to
+    the same dict — the axis name is the leading alpha run, the factor the
+    trailing digits."""
     spec = (spec or "").strip().lower()
     if spec in ("", "auto"):
         return {}
     out: dict[str, int] = {}
     for part in spec.split(","):
-        name, _, val = part.strip().partition("=")
+        part = part.strip()
+        name, eq, val = part.partition("=")
+        if not eq:
+            # compact grammar: "dp2" / "tp8" — split at the first digit
+            i = 0
+            while i < len(part) and not part[i].isdigit():
+                i += 1
+            name, val = part[:i], part[i:]
         if name not in _KNOWN:
             raise ValueError(f"unknown mesh axis {name!r} (known: {_KNOWN})")
         n = int(val)
@@ -61,7 +78,7 @@ def build_mesh(spec: str | dict[str, int] = "", devices=None) -> Mesh:
 
 
 # spellings that force unsharded (tp=1) serving regardless of device count
-_MESH_OFF = ("off", "none", "0", "1", "tp=1")
+_MESH_OFF = ("off", "none", "0", "1", "tp=1", "tp1")
 
 
 def serving_mesh(spec: str = "auto", devices=None) -> Mesh | None:
@@ -86,3 +103,36 @@ def serving_mesh(spec: str = "auto", devices=None) -> Mesh | None:
     # an oversized spec keeps the full list so build_mesh raises its clear
     # "needs N devices, have M" error
     return build_mesh(axes, devices=devices[:n] if n <= len(devices) else devices)
+
+
+def dp_submeshes(mesh: Mesh | None) -> list[Mesh | None]:
+    """Split a mesh with a dp axis into one submesh per dp slice.
+
+    The serving stack runs dp as independent batcher REPLICAS, not as a
+    batch-sharded axis inside one jit grid: each replica owns a disjoint
+    device slice (the dp axis is outermost, so slices are contiguous and
+    DCN-friendly) with the remaining (ep, sp, tp) axes intact, its own
+    slot table, KV pool, and jit grid. Weights are loaded once on host and
+    placed per slice — replicated ALONG dp, sharded WITHIN each slice — so
+    per-chip weight bytes match a single-replica mesh of the slice shape.
+
+    A mesh without dp (or ``None``) returns ``[mesh]`` unchanged. A pure-dp
+    mesh (``"dp=2"``) yields single-device submeshes carrying a size-1 tp
+    axis, which serves exactly like the unsharded path.
+    """
+    if mesh is None or mesh.shape.get(AXIS_DP, 1) <= 1:
+        return [mesh]
+    import numpy as np
+
+    names = list(mesh.axis_names)
+    i = names.index(AXIS_DP)
+    rest = tuple(n for n in names if n != AXIS_DP)
+    out: list[Mesh | None] = []
+    for k in range(mesh.shape[AXIS_DP]):
+        # np.take collapses a 1-D (pure-dp) device grid to a bare Device
+        arr = np.asarray(np.take(mesh.devices, k, axis=i))
+        if not rest:
+            out.append(Mesh(arr.reshape((1,)), (AXIS_TP,)))
+        else:
+            out.append(Mesh(arr, rest))
+    return out

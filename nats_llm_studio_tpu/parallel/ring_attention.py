@@ -28,6 +28,35 @@ from .mesh import AXIS_SP
 
 _NEG_INF = -1e30
 
+# serving gate: prompts below this many tokens prefill on the dense/flash
+# path even when the mesh has an sp axis — ring rotation latency only pays
+# for itself once the [T, T] interaction stops fitting one chip's lane
+_RING_PREFILL_MIN_DEFAULT = 4096
+
+
+def ring_prefill_min_tokens(default: int = _RING_PREFILL_MIN_DEFAULT) -> int:
+    """Token threshold (env ``RING_PREFILL_MIN_TOKENS``) above which fresh
+    prefill routes through :func:`ring_attention` on an sp>1 mesh. Read at
+    trace time — each prefill bucket's program bakes its own decision, so
+    one serving grid mixes dense short-prompt and ring long-prompt
+    programs."""
+    import os
+
+    try:
+        return int(os.environ.get("RING_PREFILL_MIN_TOKENS", default))
+    except ValueError:
+        return default
+
+
+def use_ring_prefill(mesh: Mesh | None, t: int) -> bool:
+    """Should a fresh prefill of ``t`` tokens take the ring path on this
+    mesh? Requires an sp axis > 1, the threshold, and sp | t (shard_map
+    needs equal sequence chunks)."""
+    if mesh is None or t <= 1:
+        return False
+    sp = mesh.shape.get(AXIS_SP, 1) if AXIS_SP in mesh.axis_names else 1
+    return sp > 1 and t >= ring_prefill_min_tokens() and t % sp == 0
+
 
 def _block_attn(q, k, v, mask, scale):
     """One K/V block folded into online-softmax partials.
@@ -88,9 +117,19 @@ def ring_attention(
             vc = jax.lax.ppermute(vc, axis, perm)
             return acc, m, l, kc, vc
 
-        # mark the zero-init carry as device-varying over the ring axis so the
-        # scan carry type matches its (varying) outputs
-        vary = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        # mark the zero-init carry as device-varying over the ring axis so
+        # the scan carry type matches its (varying) outputs. The marker has
+        # moved across JAX versions (pcast -> pvary) and older releases
+        # (<= 0.4.x) have neither — there the varying-axes type system does
+        # not exist and the plain carry is already correct
+        _pcast = getattr(jax.lax, "pcast", None)
+        _pvary = getattr(jax.lax, "pvary", None)
+        if _pcast is not None:
+            vary = lambda x: _pcast(x, (axis,), to="varying")
+        elif _pvary is not None:
+            vary = lambda x: _pvary(x, (axis,))
+        else:
+            vary = lambda x: x
         acc0 = vary(jnp.zeros((b, hkv, g, tq, d), jnp.float32))
         m0 = vary(jnp.full((b, hkv, g, tq), _NEG_INF, jnp.float32))
         l0 = vary(jnp.zeros((b, hkv, g, tq), jnp.float32))

@@ -216,28 +216,43 @@ def validate_mesh_for_config(mesh: Mesh, cfg: ModelConfig,
         )
     tp = mesh.shape.get(AXIS_TP, 1)
     ep = mesh.shape.get(AXIS_EP, 1)
+    # every message names the FULL axis factoring, not just the failing
+    # axis — a multi-axis mesh ("dp=2,ep=2,tp=2") read back as bare "tp=2"
+    # sends the operator hunting the wrong knob
+    factoring = ",".join(f"{k}={v}" for k, v in dict(mesh.shape).items())
+    where = f"unservable on this mesh ({factoring})"
     if cfg.n_heads % tp and tp > 1:
         raise ValueError(
-            f"unservable on this mesh: n_heads={cfg.n_heads} not divisible "
-            f"by tp={tp}"
+            f"{where}: n_heads={cfg.n_heads} not divisible by tp={tp}"
         )
     if cfg.n_kv_heads % tp and tp > 1 and not kv_replicated(mesh, cfg):
         # tp > n_kv_heads with tp | n_heads is served via the replicated-KV
         # fallback (kv_replicated); anything else has no clean layout
         raise ValueError(
-            f"unservable on this mesh: n_kv_heads={cfg.n_kv_heads} not "
+            f"{where}: n_kv_heads={cfg.n_kv_heads} not "
             f"divisible by tp={tp} (replicated-KV fallback needs "
             f"tp > n_kv_heads and tp | n_heads={cfg.n_heads})"
         )
     if cfg.d_ff % tp and tp > 1:
         raise ValueError(
-            f"unservable on this mesh: d_ff={cfg.d_ff} not divisible by tp={tp}"
+            f"{where}: d_ff={cfg.d_ff} not divisible by tp={tp}"
         )
     if cfg.is_moe and ep > 1 and cfg.n_experts % ep:
-        raise ValueError(f"n_experts={cfg.n_experts} not divisible by ep={ep}")
+        raise ValueError(
+            f"{where}: n_experts={cfg.n_experts} not divisible by ep={ep}"
+        )
+    if ep > 1 and not cfg.is_moe:
+        raise ValueError(
+            f"{where}: mesh has an ep axis but the model is dense "
+            f"(n_experts=0) — nothing shards on ep"
+        )
     sp = mesh.shape.get(AXIS_SP, 1)
     if sp > 1 and cfg.max_seq_len % sp:
-        raise ValueError(f"max_seq_len={cfg.max_seq_len} not divisible by sp={sp}")
+        raise ValueError(
+            f"{where}: max_seq_len={cfg.max_seq_len} not divisible by sp={sp}"
+        )
     pp = mesh.shape.get(AXIS_PP, 1)
     if pp > 1 and cfg.n_layers % pp:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+        raise ValueError(
+            f"{where}: n_layers={cfg.n_layers} not divisible by pp={pp}"
+        )

@@ -46,6 +46,7 @@ from ..obs.roofline import (
     dispatch_shape_key,
     efficiency_enabled,
     extract_dispatch_cost,
+    program_base,
 )
 from ..transport import faults as _faults
 from ..ops.kvcache import (
@@ -77,6 +78,20 @@ _RESERVED = object()
 # how many top-logprob (id, logprob) pairs the ext decode programs read back
 # per step; OpenAI caps top_logprobs requests well below this
 LOGPROBS_K = 8
+
+# forward-bearing programs that record under a "_moe" name suffix when the
+# model runs capacity-factor routed experts (roofline.program_family) —
+# sampling/bookkeeping programs (finish_admit, select_end, pool copies)
+# never touch the FFN and keep their plain names
+_MOE_TAGGED_PROGRAMS = frozenset({
+    "prefill1", "prefill_full", "prefill_chunk_group",
+    "admit_fused", "admit_many_fused",
+    "admit_fused_paged", "admit_many_fused_paged",
+    "decode", "decode_pos", "decode_pos_ext",
+    "decode_pos_paged", "decode_pos_paged_ext",
+    "decode_pallas", "decode_pallas_ext",
+    "spec_verify", "spec_verify_paged", "spec_verify_pallas",
+})
 
 
 class BatcherStopped(RuntimeError):
@@ -1465,6 +1480,22 @@ class ContinuousBatcher:
         self.crashed: BaseException | None = None
         self._waitlist: list[_Request] = []
 
+    def _ring_name(self, base: str, t: int) -> str | None:
+        """Per-dispatch metrics-name override for a full-prefill of padded
+        width ``t``: tagged ``_ring`` when this bucket's program takes the
+        sp ring-attention path (parallel.ring_attention.use_ring_prefill —
+        t is trace-time static, so the tag matches what the jit compiled).
+        None means "use the wrapped name"."""
+        if self.mesh is None:
+            return None
+        from ..parallel.ring_attention import use_ring_prefill
+
+        if not use_ring_prefill(self.mesh, t):
+            return None
+        if self.cfg.is_moe and getattr(self.cfg, "use_routed_moe", False):
+            base += "_moe"
+        return base + "_ring"
+
     def _timed(self, name: str, fn):
         """Wrap one jit-grid program so every dispatch lands in
         stats.program_ms[name] (and, when the caller passes ``_tokens=``,
@@ -1473,6 +1504,11 @@ class ContinuousBatcher:
         pipeline is untouched; decode_step_ms remains the
         readback-inclusive per-step number.
 
+        Forward-bearing programs of a routed-MoE model record under a
+        ``_moe``-suffixed name (roofline.program_family) — same timing,
+        same prefill/decode classification (classify_program strips the
+        suffix), distinct metrics family.
+
         With the efficiency plane on, the first dispatch per shape-bucket
         also extracts flops/bytes from XLA cost analysis — BEFORE the call,
         because the programs donate their input buffers — and every dispatch
@@ -1480,13 +1516,16 @@ class ContinuousBatcher:
         charge context, the per-request device-time ledger. A failed
         extraction caches None so a program is probed at most once per
         shape."""
+        if (name in _MOE_TAGGED_PROGRAMS and self.cfg.is_moe
+                and getattr(self.cfg, "use_routed_moe", False)):
+            name = name + "_moe"
         stats = self.stats
         eff = self._efficiency
         cost_cache: dict = {}
         is_prefill = classify_program(name) == "prefill"
-        is_spec = name in SPEC_PROGRAMS
+        is_spec = program_base(name) in SPEC_PROGRAMS
 
-        def run(*args, _tokens=None, **kwargs):
+        def run(*args, _tokens=None, _name=None, **kwargs):
             cost = None
             if eff:
                 key = dispatch_shape_key(args, kwargs)
@@ -1498,9 +1537,12 @@ class ContinuousBatcher:
             t0 = time.monotonic()
             out = fn(*args, **kwargs)
             ms = (time.monotonic() - t0) * 1e3
-            stats.record_program(name, ms, _tokens)
+            # _name: per-dispatch family tag (e.g. "prefill_full_ring" when
+            # this bucket's program takes the sp ring path) — same jit, same
+            # classification, distinct metrics row
+            stats.record_program(_name or name, ms, _tokens)
             if eff:
-                stats.record_dispatch_cost(name, cost)
+                stats.record_dispatch_cost(_name or name, cost)
                 ctx = self._charge_ctx
                 if ctx:
                     share = ms / len(ctx)
@@ -1627,6 +1669,13 @@ class ContinuousBatcher:
         """Current degradation level (0 normal / 1 brownout / 2 shed-only);
         0 when the controller is off. Plain int read — safe cross-thread."""
         return self.brownout.level if self.brownout is not None else 0
+
+    @property
+    def queue_depth(self) -> int:
+        """Admitted-but-unscheduled work: waitlist + unread inbox. Two
+        GIL-atomic reads — safe from any thread; the advert/router load
+        signal (worker.build_advert, serve/dp.py replica pick)."""
+        return self._wl_len + self._inbox.qsize()
 
     def _recorder_frame(self, depth: int, n_active: int) -> dict:
         """One compact flight-recorder frame (owner thread). Everything in
@@ -1812,6 +1861,7 @@ class ContinuousBatcher:
                         logits, k1, v1 = self._prefill_full(
                             self.params, jnp.zeros((1, b_), jnp.int32), k1, v1,
                             jnp.int32(1),
+                            _name=self._ring_name("prefill_full", b_),
                         )
                         n += 1
             else:
@@ -3177,7 +3227,7 @@ class ContinuousBatcher:
                 first, K, V, tok_dev = self._admit_fused_paged(
                     self.params, K, V, tok_dev, tokens, jnp.int32(n),
                     jnp.asarray(bids, jnp.int32), jnp.int32(slot), *samp,
-                    _tokens=n,
+                    _tokens=n, _name=self._ring_name("admit_fused_paged", bucket),
                 )
                 return first
             # long prompt: same regime choices as the legacy path (see
@@ -3257,6 +3307,7 @@ class ContinuousBatcher:
                         self.params, jnp.asarray([toks], jnp.int32), k1, v1,
                         jnp.int32(n),
                         _tokens=n,
+                        _name=self._ring_name("prefill_full", wb),
                     )
                     if chunk_logits is not None and n_full and n % C == 0:
                         chunk_logits[n_full - 1] = logits
@@ -3363,7 +3414,7 @@ class ContinuousBatcher:
                 first, K, V, tok_dev = self._admit_fused(
                     self.params, K, V, tok_dev, tokens, jnp.int32(n),
                     jnp.int32(slot), shift, *samp,
-                    _tokens=n,
+                    _tokens=n, _name=self._ring_name("admit_fused", bucket),
                 )
             else:
                 # long prompt. PREFIX-CACHE hit: copy the cached chunk
@@ -3445,6 +3496,7 @@ class ContinuousBatcher:
                             self.params, jnp.asarray([toks], jnp.int32), k1, v1,
                             jnp.int32(n),
                             _tokens=n,
+                            _name=self._ring_name("prefill_full", wb),
                         )
                         # only the prompt-end row exists here; chunk-end
                         # rows for interior chunks are backfilled if a
@@ -3577,6 +3629,7 @@ class ContinuousBatcher:
                         jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
                         jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
                         _tokens=sum(ns[i] for i in idx),
+                        _name=self._ring_name("admit_many_fused_paged", bucket),
                     )
                 else:
                     firsts, K, V, tok_dev = self._admit_many_fused(
@@ -3593,6 +3646,7 @@ class ContinuousBatcher:
                         jnp.asarray([reqs[i].sp.top_k for i in idx], jnp.int32),
                         jnp.asarray([reqs[i].sp.top_p for i in idx], jnp.float32),
                         _tokens=sum(ns[i] for i in idx),
+                        _name=self._ring_name("admit_many_fused", bucket),
                     )
             except BaseException:
                 for s in slots:  # release reservations; caller emits the error

@@ -1,0 +1,232 @@
+"""Data-parallel batcher replicas: one ``ContinuousBatcher`` per dp slice.
+
+A serving mesh with a dp axis ("dp=2,tp=2") is NOT batch-sharding inside one
+jit grid — it is N independent replicas, each owning a disjoint device slice
+(``parallel.mesh.dp_submeshes``) with the remaining (ep, sp, tp) axes intact,
+its own slot table, KV pool, prefix cache, and compiled program grid. Weights
+are replicated along dp (placed once per slice), so the whole worker serves
+dp x ``max_batch_slots`` concurrent streams at one replica's per-chip HBM
+cost. The reference gets extra throughput only by adding whole worker
+processes (SURVEY.md §3 queue groups); dp replicas get it inside one process
+sharing one host checkpoint read and one NATS connection.
+
+``DataParallelBatcher`` is the facade the registry/worker/engine layers see:
+it quacks like a ``ContinuousBatcher`` (submit, stop, stats via replica
+iteration, capacity as the SUM of replica slots) and routes each request to
+the least-loaded replica at submit time. Cross-layer consumers that need
+per-replica detail (Prometheus, flight recorder, stats snapshots) iterate
+``batcher_replicas(b)`` instead of guessing the facade's internals.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, AsyncIterator
+
+
+def batcher_replicas(b: Any) -> list[Any]:
+    """The underlying ``ContinuousBatcher`` list of any engine batcher:
+    ``[b]`` for a plain single-mesh batcher, the replica list for a
+    :class:`DataParallelBatcher`. Metrics/stats call sites iterate this so
+    one code path covers dp=1 and dp>1."""
+    reps = getattr(b, "replicas", None)
+    return list(reps) if reps else [b]
+
+
+class DataParallelBatcher:
+    """Facade over dp batcher replicas with least-loaded submit routing.
+
+    Load per replica = its admitted-but-unscheduled ``queue_depth`` plus
+    this facade's own in-flight count (streams routed here that may not
+    have reached the replica's inbox yet — the counter closes the window
+    where a burst of concurrent submits would all see depth 0 and pile
+    onto replica 0). Ties break round-robin so an idle worker still
+    spreads warm-up load across every slice.
+    """
+
+    def __init__(self, replicas: list[Any]):
+        if not replicas:
+            raise ValueError("DataParallelBatcher needs at least one replica")
+        self.replicas = list(replicas)
+        self._inflight = [0] * len(self.replicas)
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- replica selection ---------------------------------------------------
+
+    def _pick(self) -> int:
+        with self._lock:
+            self._rr += 1
+            best, best_key = 0, None
+            for i, r in enumerate(self.replicas):
+                depth = getattr(r, "queue_depth", 0) + self._inflight[i]
+                key = (depth, (i - self._rr) % len(self.replicas))
+                if best_key is None or key < best_key:
+                    best, best_key = i, key
+            self._inflight[best] += 1
+            return best
+
+    def _done(self, i: int) -> None:
+        with self._lock:
+            self._inflight[i] = max(0, self._inflight[i] - 1)
+
+    def replica_loads(self) -> list[int]:
+        """Per-replica queue depth + routed in-flight count (metrics)."""
+        with self._lock:
+            return [
+                getattr(r, "queue_depth", 0) + self._inflight[i]
+                for i, r in enumerate(self.replicas)
+            ]
+
+    # -- request path --------------------------------------------------------
+
+    async def submit_batched(self, *args, **kwargs) -> AsyncIterator[list]:
+        i = self._pick()
+        try:
+            async for batch in self.replicas[i].submit_batched(*args, **kwargs):
+                yield batch
+        finally:
+            self._done(i)
+
+    async def submit(self, *args, **kwargs) -> AsyncIterator[int]:
+        async for batch in self.submit_batched(*args, **kwargs):
+            for tok in batch:
+                yield tok
+
+    # -- prefix / KV transfer ------------------------------------------------
+
+    def export_prefix_blocks(self, prompt_ids: list[int],
+                             timeout: float = 30.0) -> dict | None:
+        """First replica with cached blocks wins — the prefill that seeded
+        the prefix may have run on any replica."""
+        for r in self.replicas:
+            out = r.export_prefix_blocks(prompt_ids, timeout=timeout)
+            if out is not None:
+                return out
+        return None
+
+    def import_prefix_blocks(self, export: dict, timeout: float = 30.0) -> dict:
+        """Seed EVERY replica so the matching request hits regardless of
+        which slice ``_pick`` routes it to. Per-replica pool exhaustion is
+        tolerated as long as one import lands; only a total wipeout
+        re-raises (the caller then falls back to local prefill)."""
+        result: dict | None = None
+        err: Exception | None = None
+        for r in self.replicas:
+            try:
+                out = r.import_prefix_blocks(export, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — per-replica best effort
+                err = e
+                continue
+            if result is None:
+                result = out
+        if result is None:
+            if err is not None:
+                raise err
+            return {"tokens": 0, "blocks": 0}
+        return result
+
+    def drop_prefix_cache(self) -> int:
+        return sum(r.drop_prefix_cache() for r in self.replicas)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.stop()
+
+    def warm_chunk_programs(self, widths: tuple[int, ...] | None = None) -> int:
+        return sum(r.warm_chunk_programs(widths) for r in self.replicas)
+
+    # -- aggregate health/capacity (quacks like one batcher) -----------------
+
+    @property
+    def max_slots(self) -> int:
+        """The advertised capacity: replicas hold disjoint slot tables, so
+        the worker really serves the sum concurrently."""
+        return sum(r.max_slots for r in self.replicas)
+
+    @property
+    def max_seq(self) -> int:
+        return min(r.max_seq for r in self.replicas)
+
+    @property
+    def max_group_admit(self) -> int:
+        """Per-replica group-admit width: a burst wider than one replica's
+        group grid still lands as one group per replica."""
+        return min(getattr(r, "max_group_admit", 1) for r in self.replicas)
+
+    @property
+    def prefill_chunk(self):
+        return self.replicas[0].prefill_chunk
+
+    @property
+    def prefix_cache(self):
+        return self.replicas[0].prefix_cache
+
+    @property
+    def stats(self):
+        """Replica 0's stats — sites that need the full picture iterate
+        :func:`batcher_replicas` (registry.stats, worker Prometheus)."""
+        return self.replicas[0].stats
+
+    @property
+    def recorder(self):
+        return self.replicas[0].recorder
+
+    @property
+    def decode_kernel(self) -> str:
+        return getattr(self.replicas[0], "decode_kernel", "xla")
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(getattr(r, "queue_depth", 0) for r in self.replicas)
+
+    @property
+    def brownout_level(self) -> int:
+        return max(r.brownout_level for r in self.replicas)
+
+    @property
+    def alive(self) -> bool:
+        return all(r.alive for r in self.replicas)
+
+    @property
+    def idle(self) -> bool:
+        return all(r.idle for r in self.replicas)
+
+    @property
+    def _stopping(self) -> bool:
+        return any(r._stopping for r in self.replicas)
+
+    def heartbeat_age_s(self) -> float:
+        return min(r.heartbeat_age_s() for r in self.replicas)
+
+    def pool_stats(self) -> dict | None:
+        """Summed pool counters across replicas (each owns its own pool)."""
+        per = [r.pool_stats() for r in self.replicas]
+        per = [p for p in per if p]
+        if not per:
+            return None
+        out: dict = {}
+        for p in per:
+            for k, v in p.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+                else:
+                    out.setdefault(k, v)
+        return out
+
+    def debug_snapshot(self) -> dict:
+        return {
+            "dp": len(self.replicas),
+            "queue_depth": self.queue_depth,
+            "replica_loads": self.replica_loads(),
+            "replicas": {
+                f"dp{i}": r.debug_snapshot()
+                for i, r in enumerate(self.replicas)
+            },
+        }

@@ -1214,14 +1214,22 @@ class LocalRegistry(Registry):
         )
         tokenizer = GGUFTokenizer.from_metadata(reader.metadata)
         quant = {t.ggml_type.name for t in reader.tensors.values()}
+        submeshes: list[Any] = [self.mesh]
         if self.mesh is not None:
             # stream tensors straight onto the mesh: peak host memory is one
             # tensor, so 70B-class files load on small-RAM workers
             from ..parallel.loader import load_params_sharded
+            from ..parallel.mesh import dp_submeshes
 
             validate_mesh_for_config(self.mesh, cfg)
+            # a dp axis means batcher REPLICAS: one submesh per dp slice
+            # (disjoint devices, ep/sp/tp intact). The GGUF streams onto
+            # slice 0; the other slices get device-to-device re-placements
+            # of the same tree below — weights replicated ALONG dp, sharded
+            # WITHIN each slice, one host read total
+            submeshes = dp_submeshes(self.mesh)
             params = load_params_sharded(
-                reader, cfg, self.mesh, quant=self.quant, group=self.wquant_group
+                reader, cfg, submeshes[0], quant=self.quant, group=self.wquant_group
             )
         elif self.quant in ("int8", "int4"):
             from ..models.llama import ensure_lm_head
@@ -1237,31 +1245,53 @@ class LocalRegistry(Registry):
             params = ensure_lm_head(load_params_from_gguf(reader, cfg))
         meta = dict(reader.metadata)
         reader.close()
-        recorder = FlightRecorder(
-            enabled=self.obs_recorder,
-            interval_ms=self.obs_recorder_interval_ms,
-            dump_dir=self.obs_dump_dir,
-            engine=model_id,
-            worker_id=self.worker_id,
-            counter_fns=self.recorder_counters,
-        )
-        batcher = ContinuousBatcher(
-            params, cfg, max_slots=self.max_batch_slots, max_seq_len=self.max_seq_len,
-            mesh=self.mesh, max_queue=self.admit_queue_limit,
-            max_queue_age_ms=self.admit_max_age_ms,
-            prefix_cache_blocks=self.prefix_cache_blocks,
-            spec_decode_k=self.spec_decode_k,
-            spec_max_active=self.spec_max_active,
-            brownout=self.brownout_cfg,
-            hbm_headroom_fn=self._hbm_headroom_frac,
-            deadline_min_tokens=self.deadline_min_tokens,
-            paged=self.kv_paged,
-            kv_block_tokens=self.kv_block_tokens,
-            kv_pool_blocks=self.kv_pool_blocks,
-            recorder=recorder,
-            **({"prefill_chunk": self.prefill_chunk}
-               if self.prefill_chunk else {}),
-        )
+        n_dp = len(submeshes)
+        replicas = []
+        for i, sub in enumerate(submeshes):
+            counters = dict(self.recorder_counters)
+            if n_dp > 1:
+                # every recorder frame of this replica carries its dp index
+                # (frames already carry the replica-local queue_depth), so
+                # a merged dump timeline stays attributable per slice
+                counters["dp_replica"] = lambda _i=i: _i
+            recorder = FlightRecorder(
+                enabled=self.obs_recorder,
+                interval_ms=self.obs_recorder_interval_ms,
+                dump_dir=self.obs_dump_dir,
+                engine=model_id if n_dp == 1 else f"{model_id}#dp{i}",
+                worker_id=self.worker_id,
+                counter_fns=counters,
+            )
+            if i == 0:
+                rep_params = params
+            else:
+                from ..parallel.sharding import shard_params
+
+                rep_params = shard_params(params, sub, cfg)
+            replicas.append(ContinuousBatcher(
+                rep_params, cfg, max_slots=self.max_batch_slots,
+                max_seq_len=self.max_seq_len,
+                mesh=sub, max_queue=self.admit_queue_limit,
+                max_queue_age_ms=self.admit_max_age_ms,
+                prefix_cache_blocks=self.prefix_cache_blocks,
+                spec_decode_k=self.spec_decode_k,
+                spec_max_active=self.spec_max_active,
+                brownout=self.brownout_cfg,
+                hbm_headroom_fn=self._hbm_headroom_frac,
+                deadline_min_tokens=self.deadline_min_tokens,
+                paged=self.kv_paged,
+                kv_block_tokens=self.kv_block_tokens,
+                kv_pool_blocks=self.kv_pool_blocks,
+                recorder=recorder,
+                **({"prefill_chunk": self.prefill_chunk}
+                   if self.prefill_chunk else {}),
+            ))
+        if n_dp > 1:
+            from .dp import DataParallelBatcher
+
+            batcher = DataParallelBatcher(replicas)
+        else:
+            batcher = replicas[0]
         if os.environ.get("TPU_WARM_ON_LOAD", "").strip() in ("1", "true"):
             # opt-in: compile every chunk/full-prefill program at load time
             # instead of pairing multi-second XLA compiles with the first
@@ -1299,9 +1329,13 @@ class LocalRegistry(Registry):
             b = eng.batcher
             recorder = None
             if b is not None:
+                from .dp import batcher_replicas
+
                 # keep the Prometheus total alive past this batcher object
-                self.inflight_failed_retryable += getattr(
-                    b.stats, "inflight_failed_retryable", 0
+                # (summed over dp replicas — each keeps its own stats)
+                self.inflight_failed_retryable += sum(
+                    getattr(r.stats, "inflight_failed_retryable", 0)
+                    for r in batcher_replicas(b)
                 )
                 # the dying batcher's flight recorder holds the pre-crash
                 # timeline; keep it past unload so the restart dump below
@@ -1374,6 +1408,13 @@ class LocalRegistry(Registry):
                 "heartbeat_age_s": round(b.heartbeat_age_s(), 3),
                 "brownout_level": int(getattr(b, "brownout_level", 0)),
             }
+            reps = getattr(b, "replicas", None)
+            if reps:
+                # dp facade: aggregates above (alive=all, brownout=max,
+                # heartbeat=min) plus per-replica routed load for the
+                # health subject's drill-down
+                out[mid]["dp"] = len(reps)
+                out[mid]["replica_loads"] = b.replica_loads()
             if mesh_shape:
                 out[mid]["mesh"] = mesh_shape
         return out
@@ -1405,18 +1446,22 @@ class LocalRegistry(Registry):
             out["engine_restarts"] = self.engine_restarts_total
         if self._poisoned:
             out["poisoned"] = dict(self._poisoned)
-        batchers = {
-            mid: eng.batcher.stats.snapshot()
-            for mid, eng in self._engines.items()
-            if eng.batcher is not None
-        }
+        from .dp import batcher_replicas
+
+        batchers: dict[str, Any] = {}
+        prefix: dict[str, Any] = {}
+        for mid, eng in self._engines.items():
+            if eng.batcher is None:
+                continue
+            reps = batcher_replicas(eng.batcher)
+            for i, r in enumerate(reps):
+                # dp>1 snapshots key per replica so per-slice load shows
+                key = mid if len(reps) == 1 else f"{mid}#dp{i}"
+                batchers[key] = r.stats.snapshot()
+                if r.prefix_cache is not None:
+                    prefix[key] = r.prefix_cache.stats()
         if batchers:
             out["batcher"] = batchers
-        prefix = {
-            mid: eng.batcher.prefix_cache.stats()
-            for mid, eng in self._engines.items()
-            if eng.batcher is not None and eng.batcher.prefix_cache is not None
-        }
         if prefix:
             out["prefix_cache"] = prefix
         return out

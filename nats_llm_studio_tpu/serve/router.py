@@ -148,6 +148,33 @@ class RecentHeads:
         return list(self._heads)
 
 
+# coarse chars-per-token for the router's long-prompt heuristic: it has no
+# tokenizer (tokenization happens on the worker), so ring-prefill preference
+# keys off character length
+_CHARS_PER_TOKEN = 4
+
+
+def _ring_min_tokens() -> int:
+    """Mirror of parallel.ring_attention.ring_prefill_min_tokens without the
+    jax import (the router is pure control plane)."""
+    try:
+        return int(os.environ.get("RING_PREFILL_MIN_TOKENS", "4096"))
+    except ValueError:
+        return 4096
+
+
+def _prompt_chars(messages) -> int:
+    n = 0
+    try:
+        for m in messages or ():
+            c = m.get("content") if isinstance(m, dict) else None
+            if isinstance(c, str):
+                n += len(c)
+    except TypeError:
+        return 0
+    return n
+
+
 @dataclass
 class WorkerAdvert:
     """One worker's most recent cluster advert, as the router sees it."""
@@ -155,13 +182,34 @@ class WorkerAdvert:
     worker_id: str
     role: str = ""  # "" monolithic / "prefill" / "decode" (ISSUE 13)
     queue_depth: int = 0
+    slots: int = 0  # advertised concurrent-stream capacity (dp x per-replica)
     brownout: int = 0  # 0 NORMAL / 1 BROWNOUT / 2 SHED_ONLY
     hbm_headroom: float = 1.0
+    mesh: dict = field(default_factory=dict)  # named axis factoring, e.g. {"dp": 2, "tp": 2}
     models: tuple[str, ...] = ()
     draining: bool = False
     heads: frozenset[str] = frozenset()
     seq: int = 0
     mono: float = 0.0  # ingest time (router clock; staleness = now - mono)
+
+    @property
+    def load(self) -> float:
+        """Queue depth normalized by advertised slot capacity: a dp=2
+        worker with 8 slots and depth 2 is LESS loaded than a dp=1 worker
+        with 4 slots and depth 2. Raw depth when capacity is unknown
+        (pre-multi-axis adverts)."""
+        if self.slots > 0:
+            return self.queue_depth / self.slots
+        return float(self.queue_depth)
+
+    @property
+    def sp_degree(self) -> int:
+        """Ring-attention sequence-parallel width from the advertised mesh
+        (1 = no sp axis — long prefills run dense on one chip's lane)."""
+        try:
+            return int(self.mesh.get("sp", 1) or 1)
+        except (TypeError, ValueError):
+            return 1
 
     @classmethod
     def from_dict(cls, d: dict) -> "WorkerAdvert | None":
@@ -169,12 +217,15 @@ class WorkerAdvert:
         if not isinstance(wid, str) or not wid:
             return None
         role = d.get("role")
+        mesh = d.get("mesh")
         return cls(
             worker_id=wid,
             role=role if isinstance(role, str) else "",
             queue_depth=int(d.get("queue_depth") or 0),
+            slots=int(d.get("slots") or 0),
             brownout=int(d.get("brownout") or 0),
             hbm_headroom=float(d.get("hbm_headroom", 1.0)),
+            mesh=dict(mesh) if isinstance(mesh, dict) else {},
             models=tuple(m for m in d.get("models") or () if isinstance(m, str)),
             draining=bool(d.get("draining")),
             heads=frozenset(h for h in d.get("heads") or () if isinstance(h, str)),
@@ -337,6 +388,14 @@ class ClusterRouter:
         head = None
         if model and messages and self.prefix_head_chars > 0:
             head = prompt_head_hash(model, messages, self.prefix_head_chars)
+        # ring-capable preference: a prompt long enough to take the sp
+        # ring-prefill path (chars/4 >= RING_PREFILL_MIN_TOKENS) prefers a
+        # worker whose advertised mesh has sp > 1 — there the prefill runs
+        # sequence-parallel instead of serializing on one chip's lane
+        long_prompt = (
+            messages is not None
+            and _prompt_chars(messages) >= _CHARS_PER_TOKEN * _ring_min_tokens()
+        )
         candidates = [
             m for m in self.members()
             if not m.draining and m.worker_id not in excluded
@@ -352,6 +411,8 @@ class ClusterRouter:
                 0 if local else 1,
                 m.brownout,
                 0 if (model and model in m.models) else 1,
+                0 if (not long_prompt or m.sp_degree > 1) else 1,
+                m.load,  # depth per advertised slot: dp replicas count
                 m.queue_depth,
                 m.worker_id,  # total order: deterministic under ties
             )
@@ -368,6 +429,8 @@ class ClusterRouter:
                 pkey = (
                     m.brownout,
                     0 if (model and model in m.models) else 1,
+                    0 if (not long_prompt or m.sp_degree > 1) else 1,
+                    m.load,
                     m.queue_depth,
                     m.worker_id,
                 )

@@ -269,27 +269,35 @@ class Worker:
     def build_advert(self) -> dict:
         """The compact membership advert ``{prefix}.cluster.adverts`` carries:
         identity, load (queue depth summed over engines, worst brownout
-        level, HBM headroom), loaded models, draining flag, and the head
-        hashes of recently served prompts (router prefix-locality)."""
+        level, HBM headroom), capacity (``slots`` summed over engines — a
+        dp>1 worker really advertises dp x per-replica slots), the named
+        mesh shape (routers prefer sp-capable workers for long prompts),
+        loaded models, draining flag, and the head hashes of recently
+        served prompts (router prefix-locality)."""
         depth = 0
         brownout = 0
+        slots = 0
         for eng in self.registry.loaded_engines().values():
             b = getattr(eng, "batcher", None)
             if b is None:
                 continue
             depth += int(getattr(b, "queue_depth", 0) or 0)
+            slots += int(getattr(b, "max_slots", 0) or 0)
             brownout = max(brownout, int(getattr(b, "brownout_level", 0) or 0))
         headroom_fn = getattr(self.registry, "_hbm_headroom_frac", None)
         try:
             headroom = float(headroom_fn()) if headroom_fn is not None else 1.0
         except Exception:  # noqa: BLE001 — an advert must never crash the loop
             headroom = 1.0
+        mesh = getattr(self.registry, "mesh", None)
         return {
             "worker_id": self.worker_id,
             "role": getattr(self.config, "worker_role", ""),
             "queue_depth": depth,
+            "slots": slots,
             "brownout": brownout,
             "hbm_headroom": round(headroom, 4),
+            "mesh": dict(mesh.shape) if mesh is not None else {},
             "models": sorted(self.registry.loaded_engines()),
             "draining": self.draining,
             "heads": self._recent_heads.snapshot(),
@@ -1470,8 +1478,12 @@ class Worker:
         engines = {}
         for mid, eng in self.registry.loaded_engines().items():
             batcher = getattr(eng, "batcher", None)
-            if batcher is not None and hasattr(batcher, "stats"):
-                engines[mid] = batcher.stats.snapshot()
+            if batcher is None or not hasattr(batcher, "stats"):
+                continue
+            reps = getattr(batcher, "replicas", None) or [batcher]
+            for ri, rb in enumerate(reps):
+                key = mid if len(reps) == 1 else f"{mid}#dp{ri}"
+                engines[key] = rb.stats.snapshot()
         devices = [
             {"id": d.id, "platform": d.platform, "kind": d.device_kind}
             for d in jax.devices()
@@ -1549,6 +1561,15 @@ class Worker:
         r.gauge("lmstudio_mesh_tp", int(mesh.get("tp", 1)),
                 help="tensor-parallel width of the serving mesh "
                      "(1 = unsharded serving)")
+        r.gauge("lmstudio_mesh_dp", int(mesh.get("dp", 1)),
+                help="data-parallel batcher replicas per worker "
+                     "(1 = single batcher)")
+        r.gauge("lmstudio_mesh_ep", int(mesh.get("ep", 1)),
+                help="expert-parallel width of the serving mesh "
+                     "(1 = experts unsharded)")
+        r.gauge("lmstudio_mesh_sp", int(mesh.get("sp", 1)),
+                help="sequence-parallel width: ring-attention prefill "
+                     "degree for long prompts (1 = off)")
         # HBM ledger (obs/roofline.py, ticked by the flight recorder):
         # priced-component sum vs the allocator's bytes_in_use. Guarded —
         # test fakes implement stats() without the ledger key.
@@ -1591,10 +1612,13 @@ class Worker:
                   help="supervisor-driven engine restarts")
         inflight_failed = getattr(self.registry, "inflight_failed_retryable", 0)
         for eng in self.registry.loaded_engines().values():
-            stats = getattr(getattr(eng, "batcher", None), "stats", None)
-            # live batchers' counts; crashed ones were harvested into the
-            # registry accumulator at restart, so no double count
-            inflight_failed += getattr(stats, "inflight_failed_retryable", 0)
+            b = getattr(eng, "batcher", None)
+            for rb in (getattr(b, "replicas", None) or [b]) if b is not None else []:
+                stats = getattr(rb, "stats", None)
+                # live batchers' counts (every dp replica); crashed ones were
+                # harvested into the registry accumulator at restart, so no
+                # double count
+                inflight_failed += getattr(stats, "inflight_failed_retryable", 0)
         r.counter("lmstudio_inflight_failed_retryable_total", inflight_failed,
                   help="in-flight requests failed with a retryable envelope "
                        "by an engine crash")
@@ -1604,11 +1628,25 @@ class Worker:
         restart_hist = getattr(self.registry, "restart_latency_ms", None)
         if restart_hist is not None:
             r.histogram("lmstudio_engine_restart_ms", restart_hist.snapshot())
+        per_replica = []
         for mid, eng in self.registry.loaded_engines().items():
-            stats = getattr(getattr(eng, "batcher", None), "stats", None)
+            b = getattr(eng, "batcher", None)
+            if b is None:
+                continue
+            reps = getattr(b, "replicas", None) or [b]
+            for ri, rb in enumerate(reps):
+                per_replica.append((mid, ri if len(reps) > 1 else None, rb))
+        for mid, ri, rb in per_replica:
+            stats = getattr(rb, "stats", None)
             if stats is None or not hasattr(stats, "histograms"):
                 continue
+            # a dp>1 engine exposes every per-batcher family once per
+            # replica under a "replica" label — the proof that an overload
+            # wave actually distributed lives in per-replica
+            # lmstudio_batcher_requests_total
             labels = {"model": mid}
+            if ri is not None:
+                labels["replica"] = str(ri)
             for name, v in stats.counters().items():
                 r.counter(f"lmstudio_batcher_{name}_total", v, labels=labels)
             r.gauge("lmstudio_batcher_peak_active_slots", stats.peak_active, labels=labels)
@@ -1627,7 +1665,7 @@ class Worker:
                       labels=labels,
                       help="mid-decode slots aborted past the client deadline")
             r.gauge("lmstudio_brownout_level",
-                    getattr(eng.batcher, "brownout_level", 0), labels=labels,
+                    getattr(rb, "brownout_level", 0), labels=labels,
                     help="0=normal 1=brownout 2=shed-only")
             # decode-kernel family: which kernel serves paged decode and how
             # many fresh decode-program compiles the window ladder has cost
@@ -1638,7 +1676,7 @@ class Worker:
                       help="first-seen (program, static-args) combos on the "
                            "decode/verify paths — each is a fresh XLA compile")
             r.gauge("lmstudio_decode_kernel_pallas",
-                    1 if getattr(eng.batcher, "decode_kernel", "xla") == "pallas"
+                    1 if getattr(rb, "decode_kernel", "xla") == "pallas"
                     else 0, labels=labels,
                     help="1 when the Pallas paged-decode kernel is serving")
             if hasattr(stats, "spec_counters"):
@@ -1706,7 +1744,7 @@ class Worker:
                         help="served tokens per device-second across ALL "
                              "attributed device time (waste included in "
                              "the denominator)")
-            pool_stats_fn = getattr(eng.batcher, "pool_stats", None)
+            pool_stats_fn = getattr(rb, "pool_stats", None)
             pool = pool_stats_fn() if pool_stats_fn is not None else None
             if pool is not None:
                 # paged-KV block pool residency: total/free/shared block
@@ -1720,7 +1758,7 @@ class Worker:
                           pool["cow_copies"], labels=labels,
                           help="copy-on-write block duplications (a shared "
                                "block written by a live slot)")
-            pcache = getattr(eng.batcher, "prefix_cache", None)
+            pcache = getattr(rb, "prefix_cache", None)
             if pcache is not None:
                 # two new families: lmstudio_prefix_cache_*_total counters
                 # (hits/misses/full_hits/hit_tokens/inserted/evicted blocks)
