@@ -119,6 +119,44 @@ class WorkerConfig:
     kv_pool_blocks: int = field(
         default_factory=lambda: int(_env("KV_POOL_BLOCKS", "0"))
     )
+    # -- hierarchical KV tiers (serve/kv_tiers.py) ---------------------------
+    # host-RAM tier budget in bytes under the HBM block pool: evicted/
+    # reclaimed prefix-cache chunks demote here (and spill onward to the
+    # Object Store) instead of being dropped. 0 disables tiering entirely.
+    kv_host_pool_bytes: int = field(
+        default_factory=lambda: int(_env("KV_HOST_POOL_BYTES", str(256 << 20)))
+    )
+    # spill host-tier evictions to the JetStream Object Store as KVX1 blobs
+    # (bucket "kv-tier"); the cold tier survives process death, so a
+    # respawned worker warm-imports its hottest prefixes with no live donor
+    kv_spill_objstore: bool = field(
+        default_factory=lambda: _env("KV_SPILL_OBJSTORE", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    # slot suspend/resume (swap-don't-shed): under pool exhaustion or
+    # SHED_ONLY brownout, demote a victim slot's KV + resume state to host
+    # RAM and continue it later bit-identically instead of shedding/
+    # cancelling. KV_SUSPEND=0 is the kill switch (pre-tier shed behavior).
+    kv_suspend: bool = field(
+        default_factory=lambda: _env("KV_SUSPEND", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    # proactive demotion low-water mark: each owner tick with the pool's
+    # free fraction below this, cold cache chunks demote to the host tier
+    # ahead of demand (admission then allocates without synchronous swaps)
+    kv_demote_free_frac: float = field(
+        default_factory=lambda: float(_env("KV_DEMOTE_FREE_FRAC", "0.10"))
+    )
+    # promotion-on-hit ceiling: at most this many tiered chunks re-enter the
+    # pool per admit (bounds the synchronous device_put burst a deep
+    # host-tier hit can inject ahead of one prefill)
+    kv_promote_chunks: int = field(
+        default_factory=lambda: int(_env("KV_PROMOTE_CHUNKS", "64"))
+    )
+    # cold-tier object-count cap; shallowest chains purge first
+    kv_spill_max_objects: int = field(
+        default_factory=lambda: int(_env("KV_SPILL_MAX_OBJECTS", "512"))
+    )
     # speculative decoding (serve/spec.py): max prompt-lookup draft tokens
     # per slot per verify dispatch. SPEC_DECODE=0 is the hard off-switch
     # (wins over SPEC_DECODE_K); SPEC_DECODE_K=0 also disables. NOTE: k > 0

@@ -99,6 +99,7 @@ async def _run_serve(args: argparse.Namespace) -> None:
         brownout=cfg.brownout,
         kv_paged=cfg.kv_paged, kv_block_tokens=cfg.kv_block_tokens,
         kv_pool_blocks=cfg.kv_pool_blocks,
+        kv_host_pool_bytes=cfg.kv_host_pool_bytes,
         restart_backoff_s=cfg.engine_restart_backoff_s,
         restart_backoff_max_s=cfg.engine_restart_backoff_max_s,
         max_restarts=cfg.engine_max_restarts,

@@ -119,6 +119,23 @@ def kv_nbytes(cache) -> int:
     return cache.size * cache.dtype.itemsize
 
 
+def host_kv_nbytes(leaf) -> int:
+    """Host bytes of one transferred/demoted KV leaf: an ndarray, a KVQ
+    pytree, or the wire-normalized ``(codes, scales)`` tuple
+    (serve/kv_transfer.py) — the host-tier budget's accounting unit."""
+    if leaf is None:
+        return 0
+    if isinstance(leaf, tuple):
+        q, s = leaf
+        return int(q.size) * q.dtype.itemsize + int(s.size) * s.dtype.itemsize
+    if is_quantized(leaf):
+        return (
+            int(leaf.q.size) * leaf.q.dtype.itemsize
+            + int(leaf.s.size) * leaf.s.dtype.itemsize
+        )
+    return int(leaf.size) * leaf.dtype.itemsize
+
+
 def kv_gather_block(cache, row: int, start: int, length: int):
     """Copy one row's S-axis block [start, start+length) out of a
     [B, L, H, S, D]-layout cache as a fresh [1, L, H, length, D] array (or

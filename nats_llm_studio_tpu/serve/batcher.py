@@ -128,7 +128,7 @@ class _ControlOp:
     __slots__ = ("kind", "args", "done", "result", "error", "cancelled")
 
     def __init__(self, kind: str, args: dict):
-        self.kind = kind  # "export" | "import"
+        self.kind = kind  # "export" | "import" | "suspend_harvest"
         self.args = args
         self.done = threading.Event()
         self.result = None
@@ -153,6 +153,37 @@ class _ControlOp:
                 f"batcher stopped ({value}) before kv {self.kind} ran; "
                 f"retry on another worker"
             ))
+
+
+class _Suspended:
+    """A slot parked on the host tier (swap-don't-shed). Holds the host
+    copies of the slot's KV blocks plus everything resume needs to be
+    bit-identical under greedy: position, rng step/seed, the spec-decode
+    n-gram state (by reference — its history already includes every
+    delivered token), and the request itself (whose ``emitted`` tail
+    re-seeds the device carry token). Owner thread only."""
+
+    __slots__ = ("req", "k", "v", "n_blocks", "min_blocks", "pos", "steps",
+                 "seed", "spec", "t_suspend", "reason")
+
+    def __init__(self, req, k, v, n_blocks, pos, steps, seed, spec,
+                 t_suspend, reason, min_blocks=None):
+        self.req = req
+        self.k = k
+        self.v = v
+        self.n_blocks = n_blocks
+        # resume gate: don't re-admit until this many blocks are free. For
+        # a slot parked by a FAILED mid-decode growth this covers n_blocks
+        # plus the growth it could not take — resuming at exactly n_blocks
+        # would re-fail the same growth and park again, a livelock that
+        # starves the slots the parking was meant to unblock.
+        self.min_blocks = n_blocks if min_blocks is None else min_blocks
+        self.pos = pos
+        self.steps = steps
+        self.seed = seed
+        self.spec = spec
+        self.t_suspend = t_suspend
+        self.reason = reason
 
 
 @dataclass
@@ -205,6 +236,11 @@ class _Request:
     # failed (disaggregated KV pull fell back to a local re-prefill): the
     # prefill share of a served request lands here instead of "served"
     waste_tag: str | None = None
+    # token ids actually delivered to the consumer, in order. prompt_ids +
+    # emitted is the slot's exact token history; slot suspend relies on it
+    # (resume re-seeds the device carry token from the tail, and suspend
+    # refuses a slot whose history length disagrees with its position)
+    emitted: list = field(default_factory=list)
 
     @property
     def is_ext(self) -> bool:
@@ -502,6 +538,8 @@ class ContinuousBatcher:
         kv_block_tokens: int = 16,
         kv_pool_blocks: int = 0,
         recorder=None,
+        kv_tiers=None,
+        kv_suspend: bool | None = None,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -696,6 +734,36 @@ class ContinuousBatcher:
         # frame per interval and the anomaly paths (crash, pool
         # exhaustion, SHED_ONLY entry) dump through it; None = off
         self.recorder = recorder
+        # hierarchical KV tiers (serve/kv_tiers.py KVTierManager): host-RAM
+        # spill + Object Store behind the paged prefix cache. Only
+        # meaningful with paged KV AND a radix cache — the cache is both
+        # the demotion source (evicted-not-discarded chunks) and the
+        # promotion target. The manager holds host/Object-Store bytes only;
+        # every device transfer stays on the owner thread.
+        self.kv_tiers = (
+            kv_tiers if (self.paged and self.prefix_cache is not None) else None
+        )
+        # slot suspend/resume (swap-don't-shed): on pool exhaustion or the
+        # SHED_ONLY edge a victim slot's blocks + full resume state move to
+        # host RAM and the slot resumes later, bit-identical under greedy.
+        # None → KV_SUSPEND env; "0" is the kill switch that restores the
+        # pre-tier shed-on-exhaustion behavior exactly.
+        if kv_suspend is None:
+            kv_suspend = os.environ.get("KV_SUSPEND", "1").strip().lower() not in (
+                "0", "false", "off"
+            )
+        self.kv_suspend = bool(kv_suspend) and self.paged
+        # suspended-slot records (owner thread mutates; len() is read
+        # cross-thread for metrics/adverts — list ref swap + len are
+        # GIL-safe) and lifetime suspend counters, kept off BatcherStats so
+        # the stats snapshot shape stays a stable contract
+        self._suspended: list = []
+        self._suspend_stats = {
+            "suspended_total": 0,
+            "resumed_total": 0,
+            "suspend_failures": 0,
+            "suspended_deadline_expired": 0,
+        }
         # owner-maintained snapshot of the live slots for debug_snapshot()
         # (the real tables/host_pos are _run locals): slot -> {pos,
         # generated, blocks, ...}. Replaced wholesale each loop iteration
@@ -1706,6 +1774,15 @@ class ContinuousBatcher:
             fr["pool_blocks_free"] = ps["blocks_free"]
             fr["pool_blocks_live"] = ps["blocks_live"]
             fr["pool_blocks_shared"] = ps["blocks_shared"]
+        if self._suspended or self._suspend_stats["suspended_total"]:
+            fr["suspended_slots"] = len(self._suspended)
+            fr["suspended_total"] = self._suspend_stats["suspended_total"]
+        if self.kv_tiers is not None:
+            ts = self.kv_tiers.stats()
+            fr["tier_host_bytes"] = ts["host_bytes"]
+            fr["tier_host_entries"] = ts["host_entries"]
+            fr["tier_demoted_chunks"] = ts["demoted_chunks"]
+            fr["tier_promoted_chunks"] = ts["promoted_chunks"]
         if self._efficiency:
             dt = st.device_time_snapshot()["ms"]
             # only nonzero categories: frames are size-sensitive
@@ -1807,6 +1884,10 @@ class ContinuousBatcher:
             self._thread.join(timeout=30.0)
         # anything enqueued between the owner thread's final drain and here
         self._drain_all("shutdown")
+        if self.kv_tiers is not None:
+            # flush pending spills so the Object Store tier is complete for
+            # the restart-with-warm-cache path, then stop the spill thread
+            self.kv_tiers.close()
 
     @property
     def idle(self) -> bool:
@@ -1887,6 +1968,33 @@ class ContinuousBatcher:
         releases them. Returns the number of blocks evicted."""
         pc = self.prefix_cache
         return pc.resize(0) if pc is not None else 0
+
+    def tier_stats(self) -> dict | None:
+        """KV tier + slot-suspend counters for metrics/bench (None when
+        neither tiering nor suspend is on). Thread-safe snapshot."""
+        if self.kv_tiers is None and not self.kv_suspend:
+            return None
+        out = dict(self._suspend_stats)
+        out["suspended"] = len(self._suspended)
+        if self.kv_tiers is not None:
+            out.update(self.kv_tiers.stats())
+        if self.prefix_cache is not None:
+            c = self.prefix_cache.counters()
+            out["demoted_blocks"] = c.get("demoted_blocks", 0)
+            out["demote_failures"] = (
+                out.get("demote_failures", 0) + c.get("demote_failures", 0)
+            )
+        return out
+
+    def suspend_harvest_to_cache(self, timeout: float = 30.0) -> dict:
+        """Suspend every active slot and fold its full token history
+        (prompt + generated, whole chunks) into the radix prefix cache,
+        then fail the request with a retryable envelope. The drain path
+        calls this at its deadline so a warm handoff ships *in-progress*
+        work too: the survivor serves the client's retry as a prefix hit
+        instead of re-prefilling from scratch (zero-lost-work preemption).
+        Returns {"slots": n, "tokens": cached_tokens}."""
+        return self._control(_ControlOp("suspend_harvest", {}), timeout)
 
     def _make_row_cache(self, batch: int, seq_len: int):
         """Fresh transient prefill cache, committed with the row sharding
@@ -2300,17 +2408,50 @@ class ContinuousBatcher:
         tbl_dev = jnp.zeros((B, max(MB, 1)), jnp.int32)
         table_dirty = False
 
-        def alloc_blocks(k: int) -> list[int]:
+        # hierarchical KV tiers + slot suspend (owner-thread handles)
+        tier = self.kv_tiers
+        suspend_on = self.kv_suspend and paged
+
+        def alloc_blocks(k: int, suspend_ok: bool = True,
+                         internal: bool = False) -> list[int]:
             """Take k fresh pool blocks; on shortage, reclaim unpinned
-            prefix-cache blocks (the evictable tier) and retry. Raises
-            _PoolExhausted BEFORE any device dispatch so the caller sheds
-            one request instead of resetting the cache."""
+            prefix-cache blocks (the evictable tier — demoted to the host
+            tier when one is attached, discarded otherwise), then suspend
+            victim slots (swap-don't-shed), and only shed when every lever
+            is exhausted. Raises _PoolExhausted BEFORE any device dispatch
+            so the caller sheds one request instead of resetting the cache.
+
+            ``suspend_ok=False`` marks decode-time growth (ensure_blocks/
+            ensure_private): those run mid-burst-preparation over a frozen
+            active-slot list, where removing a slot would corrupt the
+            dispatch. ``internal=True`` marks opportunistic allocations
+            (tier promotion, slot resume) — they must neither suspend
+            another slot (thrash cycles) nor count a shed (the caller just
+            defers the work), so exhaustion raises a quiet _PoolExhausted."""
             got = pool.alloc(k)
             if got is None and pc is not None:
-                pc.reclaim(k - pool.free_blocks)
+                pc.reclaim(k - pool.free_blocks, demote=tier is not None)
                 got = pool.alloc(k)
+            if got is None and suspend_on and suspend_ok and not internal:
+                # swap-don't-shed: demote whole victim slots (blocks + full
+                # resume state) to the host tier until the allocation fits
+                while got is None and suspend_victim():
+                    if pc is not None and pool.free_blocks < k:
+                        pc.reclaim(
+                            k - pool.free_blocks, demote=tier is not None
+                        )
+                    got = pool.alloc(k)
             if got is None:
-                self.stats.record_shed("kv_pool")
+                if internal:
+                    raise _PoolExhausted(
+                        f"pool busy ({k} blocks needed, "
+                        f"{pool.free_blocks} free); deferred"
+                    )
+                if suspend_ok:
+                    # decode-time growth (suspend_ok=False) does NOT count
+                    # a shed here: grow_for_burst may park the slot instead
+                    # of shedding it, and records the shed itself when not
+                    self.stats.record_shed("kv_pool")
                 if self.recorder is not None:
                     # rate-limited (not forced): a starved pool sheds every
                     # admit attempt, one dump per window tells the story
@@ -2332,7 +2473,7 @@ class ContinuousBatcher:
             need = min(-(-min(upto, self.max_seq) // T), MB)
             tbl = tables[i]
             if len(tbl) < need:
-                tbl.extend(alloc_blocks(need - len(tbl)))
+                tbl.extend(alloc_blocks(need - len(tbl), suspend_ok=False))
                 table_dirty = True
 
         def ensure_private(i: int, lo: int, hi: int) -> None:
@@ -2351,7 +2492,7 @@ class ContinuousBatcher:
             for b in range(b0, b1 + 1):
                 bid = tbl[b]
                 if bid != 0 and pool.refcount(bid) > 1:
-                    nid = alloc_blocks(1)[0]
+                    nid = alloc_blocks(1, suspend_ok=False)[0]
                     K, V = self._pool_copy_block(
                         K, V, jnp.int32(nid), jnp.int32(bid)
                     )
@@ -2359,6 +2500,49 @@ class ContinuousBatcher:
                     pool.cow_copies += 1
                     tbl[b] = nid
                     table_dirty = True
+
+        def grow_for_burst(act, upto_of, prev_ctx) -> bool:
+            """Grow every active row's table (plus CoW privatization) ahead
+            of a burst dispatch. ``ensure_blocks`` deliberately never
+            suspends (the active-slot list is frozen mid-preparation), so
+            exhaustion lands here — BEFORE any dispatch, device buffers
+            intact. Resolve it by aborting the round and removing just the
+            overflowing slot: PARK it on the host tier when parking can
+            ever succeed (zero lost work — it resumes and regrows once
+            blocks free up), shed it retryably when it cannot (its full
+            extent exceeds the pool, or no other slot will ever release
+            blocks, so resume would re-fail the same growth forever). The
+            other streams keep their tokens either way; without this the
+            escape used to reach the blanket dispatch handler and reset
+            the whole cache. Returns False when the caller must skip the
+            round (the slot list is stale)."""
+            i = -1
+            try:
+                for i in act:
+                    ensure_blocks(i, upto_of(i))
+                    ensure_private(i, host_pos[i], upto_of(i))
+                return True
+            except _PoolExhausted as e:
+                self._charge_ctx = prev_ctx
+                r = self._slots[i]
+                fits = isinstance(r, _Request) and min(
+                    -(-(len(r.prompt_ids) + r.sp.max_tokens) // T), MB
+                ) <= pool.n_blocks - 1
+                others = any(
+                    j != i and isinstance(self._slots[j], _Request)
+                    for j in range(B)
+                )
+                need = min(-(-min(upto_of(i), self.max_seq) // T), MB)
+                if (fits and others and suspend_on
+                        and suspend_slot(i, "growth", min_blocks=need)):
+                    return False
+                r = self._slots[i]  # the suspend drain may have finished it
+                if isinstance(r, _Request):
+                    self.stats.record_shed("kv_pool")
+                    self._ledger_finalize(r, "shed_after_prefill")
+                    r.emit("err", e)
+                    finish_slot(i)
+                return False
 
         def refresh_tables() -> None:
             """Mirror the host block tables to the device [B, MB] array the
@@ -2800,9 +2984,8 @@ class ContinuousBatcher:
                 # nb*T rides the SAME pow2 ladder as the contiguous
                 # positional window, so softmax reduction extents match
                 # bit-for-bit.
-                for i in act:
-                    ensure_blocks(i, min(host_pos[i] + n, self.max_seq))
-                    ensure_private(i, host_pos[i], host_pos[i] + n)
+                if not grow_for_burst(act, lambda i: host_pos[i] + n, prev_ctx):
+                    return
                 refresh_tables()
                 if use_pallas:
                     self._note_compile("decode_pallas", n)
@@ -2889,9 +3072,8 @@ class ContinuousBatcher:
                     mask[i, : dm.shape[0]] = dm
             mask_dev = jnp.asarray(mask)
             if paged:
-                for i in act:
-                    ensure_blocks(i, min(host_pos[i] + 1, self.max_seq))
-                    ensure_private(i, host_pos[i], host_pos[i] + 1)
+                if not grow_for_burst(act, lambda i: host_pos[i] + 1, prev_ctx):
+                    return
                 refresh_tables()
                 if use_pallas:
                     self._note_compile("decode_pallas_ext")
@@ -2968,9 +3150,10 @@ class ContinuousBatcher:
             )
             refresh_rows()
             if paged:
-                for i in act:
-                    ensure_blocks(i, min(host_pos[i] + kspec + 1, self.max_seq))
-                    ensure_private(i, host_pos[i], host_pos[i] + kspec + 1)
+                if not grow_for_burst(
+                    act, lambda i: host_pos[i] + kspec + 1, prev_ctx
+                ):
+                    return False  # slot list is stale; plain burst re-scans
                 refresh_tables()
                 if use_pallas:
                     self._note_compile("spec_verify_pallas", kspec)
@@ -3081,6 +3264,300 @@ class ContinuousBatcher:
                 return KVQ(q=jnp.asarray(np.asarray(q)),
                            s=jnp.asarray(np.asarray(s)))
             return jnp.asarray(np.asarray(leaf))
+
+        if tier is not None and pc is not None and paged:
+            def _demote_chunk(token_ids, payload, logits) -> bool:
+                """Prefix-cache eviction hook (owner thread, pc lock held):
+                read the evicted node's pool blocks back to host in one
+                batched gather and hand them to the tier manager — LRU
+                eviction becomes demotion. False (plain eviction) for
+                payloads that survived a pool reset: their ids reference
+                recycled blocks."""
+                ep, ids = payload
+                if ep != pool.epoch:
+                    return False
+                bids = jnp.asarray(ids, jnp.int32)
+                k_host = _host_kv(kv_pool_read_blocks(K, bids))
+                v_host = _host_kv(kv_pool_read_blocks(V, bids))
+                return tier.demote(token_ids, k_host, v_host, logits)
+
+            pc.demote_fn = _demote_chunk
+
+        def suspend_slot(i: int, reason: str,
+                         min_blocks: int | None = None) -> bool:
+            """Demote slot i (KV blocks + full resume state) to the host
+            side and free the slot — swap-don't-shed. Returns False with
+            the slot untouched when it is not suspendable (mid-admit, no
+            tier-consistent state, readback failure); the caller falls back
+            to the existing shed path. Chaos hook: a ``raise`` rule at
+            SUSPEND is a worker dying mid-suspend (pump crash, supervisor
+            restart); any other kind aborts the suspend before any state
+            has moved."""
+            if not suspend_on:
+                return False
+            req = self._slots[i]
+            if not isinstance(req, _Request) or req.cancelled:
+                return False
+            if _faults.ACTIVE is not None:
+                f = _faults.ACTIVE.check(_faults.SUSPEND)
+                if f is not None:
+                    self._suspend_stats["suspend_failures"] += 1
+                    if f.kind == "raise":
+                        raise f.exception()
+                    return False
+            # drain every in-flight dispatch first: delivered tokens,
+            # positions and rng step counters must agree before the state
+            # is frozen (a pending burst would deliver tokens the captured
+            # state does not cover)
+            pump(0)
+            req = self._slots[i]
+            if not isinstance(req, _Request) or req.cancelled:
+                return False  # finished or cancelled during the drain
+            hist = len(req.prompt_ids) + len(req.emitted)
+            if hist != host_pos[i] + 1 or not tables[i]:
+                # a state the resume path cannot rebuild exactly (e.g. a
+                # reserved/partial admit): refuse rather than resume wrong
+                self._suspend_stats["suspend_failures"] += 1
+                return False
+            try:
+                bids = jnp.asarray(tables[i], jnp.int32)
+                k_host = _host_kv(kv_pool_read_blocks(K, bids))
+                v_host = _host_kv(kv_pool_read_blocks(V, bids))
+            except Exception:  # noqa: BLE001 — readback failed; keep in HBM
+                log.exception("suspend readback failed; slot %d stays", i)
+                self._suspend_stats["suspend_failures"] += 1
+                return False
+            srec = _Suspended(
+                req=req, k=k_host, v=v_host, n_blocks=len(tables[i]),
+                pos=host_pos[i], steps=host_steps[i], seed=host_seed[i],
+                spec=spec_slots[i], t_suspend=time.monotonic(),
+                reason=reason, min_blocks=min_blocks,
+            )
+            finish_slot(i)  # decrefs the blocks; the host copy owns the KV
+            self._suspended.append(srec)
+            self._suspend_stats["suspended_total"] += 1
+            obs_emit(
+                "slot_suspend", slot=i, reason=reason, pos=srec.pos,
+                generated=req.generated, blocks=srec.n_blocks,
+            )
+            return True
+
+        def suspend_victim() -> bool:
+            """Suspend the slot whose demotion frees the most pool blocks
+            (falling through candidates a drain disqualifies). False when
+            nothing is suspendable."""
+            cand = sorted(
+                (i for i, r in enumerate(self._slots)
+                 if isinstance(r, _Request) and not r.cancelled and tables[i]),
+                key=lambda i: len(tables[i]), reverse=True,
+            )
+            for i in cand:
+                if suspend_slot(i, "kv_pool"):
+                    return True
+            return False
+
+        def resume_suspended() -> None:
+            """Re-admit suspended slots (oldest first) while free slots and
+            pool blocks allow. Bit-identical resume: the host KV copies are
+            written into freshly allocated blocks, the pos/rng-step/seed
+            mirrors are restored, and the device carry token is re-seeded
+            from the delivered-token tail — the next decode step computes
+            exactly what it would have without the suspension."""
+            nonlocal K, V, tok_dev, dirty, table_dirty
+            if not self._suspended:
+                return
+            bo = self.brownout
+            if bo is not None and bo.level >= SHED_ONLY:
+                return  # still inside the incident window that parked them
+            pending = self._suspended
+            while pending and None in self._slots:
+                rec = pending[0]
+                req = rec.req
+                if req.cancelled:
+                    pending.pop(0)
+                    self._ledger_finalize(
+                        req,
+                        "deadline_abort" if req.deadline_hit else "cancelled",
+                    )
+                    self.stats.record_cancel("active")
+                    continue
+                if pool.free_blocks < rec.min_blocks:
+                    # growth-parked slots wait for headroom beyond their
+                    # own tables (see _Suspended.min_blocks); reclaim the
+                    # evictable cache toward it like alloc_blocks would
+                    if pc is not None:
+                        pc.reclaim(
+                            rec.min_blocks - pool.free_blocks,
+                            demote=tier is not None,
+                        )
+                    if pool.free_blocks < rec.min_blocks:
+                        return  # pool still tight; retry next tick
+                try:
+                    # internal: a resume must never suspend another slot to
+                    # make room (thrash), and a full pool is a deferral, not
+                    # a shed
+                    ids = alloc_blocks(rec.n_blocks, internal=True)
+                except _PoolExhausted:
+                    return  # pool still tight; retry next tick
+                slot = self._slots.index(None)
+                try:
+                    bids = jnp.asarray(ids, jnp.int32)
+                    K = kv_pool_write_row(K, _dev_kv(rec.k), bids)
+                    V = kv_pool_write_row(V, _dev_kv(rec.v), bids)
+                    if self.mesh is not None:
+                        # same re-pin as control_import: the eager writes
+                        # may lose the pool sharding the donated dispatches
+                        # were compiled for
+                        from ..parallel.sharding import pool_spec, shard_cache
+
+                        K, V = shard_cache(
+                            K, V, self.mesh, cfg=cfg,
+                            spec=pool_spec(self.mesh, cfg),
+                        )
+                except Exception as e:  # noqa: BLE001 — host copy unusable
+                    pool.decref(ids)
+                    pending.pop(0)
+                    self._suspend_stats["suspend_failures"] += 1
+                    self._ledger_finalize(req, "failed")
+                    try:
+                        req.emit("err", BatcherOverloaded(
+                            f"resume failed after {req.generated} tokens "
+                            f"({e}); retry on another worker"
+                        ))
+                    except Exception:  # noqa: BLE001 — dead client loop
+                        pass
+                    continue
+                pending.pop(0)
+                tables[slot] = list(ids)
+                table_dirty = True
+                req.slot = slot
+                self._slots[slot] = req
+                host_pos[slot] = rec.pos
+                host_steps[slot] = rec.steps
+                host_seed[slot] = rec.seed
+                spec_slots[slot] = rec.spec
+                carry = req.emitted[-1] if req.emitted else req.prompt_ids[-1]
+                tok_dev = tok_dev.at[slot].set(jnp.int32(carry))
+                dirty = True
+                self._suspend_stats["resumed_total"] += 1
+                obs_emit(
+                    "slot_resume", slot=slot, reason=rec.reason, pos=rec.pos,
+                    generated=req.generated,
+                    suspended_ms=round(
+                        (time.monotonic() - rec.t_suspend) * 1e3, 1
+                    ),
+                )
+
+        def promote_from_tier(prompt_ids) -> None:
+            """Pull host/spill-tier chunks that EXTEND this prompt's cached
+            prefix back into the pool + radix cache (promotion-on-hit), so
+            the match that follows resumes from the deepest tier-covered
+            chunk. Bounded by ``tier.promote_chunks`` per admit; exhaustion
+            or any failure leaves the cache exactly as it was (fresh
+            allocations are dropped, survivors are owned by acquire_fn)."""
+            nonlocal K, V
+            if tier is None or pc is None or tier.promote_chunks <= 0:
+                return
+            C = self.prefill_chunk
+            n_full = len(prompt_ids) // C
+            have = pc.peek(prompt_ids) // C
+            if n_full <= have:
+                return
+            nbc = C // T
+            token_ids = [int(t) for t in prompt_ids[: n_full * C]]
+            payloads: list = [None] * have
+            logits_list: list = [None] * have
+            alloc: list[int] = []
+            found = 0
+            try:
+                for j in range(have, min(n_full, have + tier.promote_chunks)):
+                    ent = tier.lookup(tuple(token_ids[: (j + 1) * C]))
+                    if ent is None:
+                        break
+                    ids = alloc_blocks(nbc, internal=True)
+                    alloc.extend(ids)
+                    bids = jnp.asarray(ids, jnp.int32)
+                    K = kv_pool_write_row(K, _dev_kv(ent.k), bids)
+                    V = kv_pool_write_row(V, _dev_kv(ent.v), bids)
+                    payloads.append((pool.epoch, list(ids)))
+                    logits_list.append(
+                        None if ent.logits is None
+                        else jnp.asarray(ent.logits, jnp.float32)
+                    )
+                    found += 1
+            except _PoolExhausted:
+                pass  # promote what fit; the admit itself decides the rest
+            except Exception:  # noqa: BLE001 — promotion is best-effort
+                log.exception("tier promotion failed; continuing without")
+                if alloc:
+                    pool.decref(alloc)
+                return
+            if found == 0:
+                if alloc:
+                    pool.decref(alloc)
+                return
+            if self.mesh is not None:
+                from ..parallel.sharding import pool_spec, shard_cache
+
+                K, V = shard_cache(
+                    K, V, self.mesh, cfg=cfg,
+                    spec=pool_spec(self.mesh, cfg),
+                )
+            pc.insert(token_ids[: (have + found) * C], payloads, logits_list)
+            # acquire_fn holds the surviving refs; these fresh ones drop
+            # (mirrors control_import — an insert cut short frees everything)
+            pool.decref(alloc)
+            tier.note_promoted(found)
+
+        def suspend_harvest() -> dict:
+            """Drain-path zero-lost-work: fold every active slot's full
+            token history (whole chunks of prompt + generated KV, already
+            sitting in pool blocks) into the radix prefix cache, then fail
+            the request with the retryable draining envelope. The warm
+            handoff that follows (worker.begin_drain) ships these chunks to
+            the survivor, so the client's retry admits as a prefix hit that
+            covers the generated tokens too — not a from-scratch prefill."""
+            pump(0)
+            done = 0
+            cached_tokens = 0
+            C = self.prefill_chunk
+            nbc = C // T if (paged and T) else 0
+            for i in range(B):
+                req = self._slots[i]
+                if not isinstance(req, _Request):
+                    continue
+                if pc is not None and nbc and not req.cancelled:
+                    hist = list(req.prompt_ids) + [
+                        int(t) for t in req.emitted
+                    ]
+                    n_full = min(host_pos[i], len(hist)) // C
+                    if n_full > 0:
+                        tbl = tables[i]
+                        payloads: list = []
+                        for j in range(n_full):
+                            ids = tbl[j * nbc : (j + 1) * nbc]
+                            payloads.append(
+                                (pool.epoch, list(ids))
+                                if len(ids) == nbc else None
+                            )
+                        try:
+                            pc.insert(
+                                hist[: n_full * C], payloads, [None] * n_full
+                            )
+                            cached_tokens += n_full * C
+                        except Exception:  # noqa: BLE001 — best-effort
+                            log.exception("suspend-harvest insert failed")
+                self._ledger_finalize(req, "served")
+                finish_slot(i)
+                try:
+                    req.emit("err", BatcherOverloaded(
+                        f"worker draining; {req.generated} generated tokens "
+                        f"cached for warm handoff; retry on another worker"
+                    ))
+                except Exception:  # noqa: BLE001 — dead client loop
+                    pass
+                done += 1
+            return {"slots": done, "tokens": cached_tokens}
 
         def control_export(args) -> dict | None:
             """Owner-thread half of disaggregated PREFILL: gather the
@@ -3198,6 +3675,8 @@ class ContinuousBatcher:
                     op.finish(result=control_export(op.args))
                 elif op.kind == "import":
                     op.finish(result=control_import(op.args))
+                elif op.kind == "suspend_harvest":
+                    op.finish(result=suspend_harvest())
                 else:
                     op.finish(error=ValueError(
                         f"unknown control op {op.kind!r}"
@@ -3236,6 +3715,10 @@ class ContinuousBatcher:
             n_full = n // C
             nbc = C // T
             chunk_logits = [None] * n_full if pc is not None else None
+            # promotion-on-hit: chunks the HBM cache evicted to the host /
+            # Object Store tiers come back into the pool before the match,
+            # so the hit below covers the deepest tier-resident prefix
+            promote_from_tier(req.prompt_ids)
             hit = pc.match(req.prompt_ids) if pc is not None else None
             if hit is not None and any(
                 p2 is None or p2[0] != pool.epoch for p2 in hit.payloads
@@ -3903,12 +4386,25 @@ class ContinuousBatcher:
                     raise f.exception()
             act = active()
             self.stats.peak_active = max(self.stats.peak_active, len(act))
-            # intake: block when fully idle, otherwise just drain what's queued
-            block = not act and not waitlist and not inflight
+            # intake: block when fully idle, otherwise just drain what's
+            # queued. Suspended slots keep their deadline clocks running,
+            # so with any parked the idle park becomes a bounded poll (the
+            # suspended sweep/resume below must keep ticking); when a
+            # resume is already possible, don't wait at all.
+            bo0 = self.brownout
+            can_resume = bool(
+                self._suspended
+                and None in self._slots
+                and (bo0 is None or bo0.level < SHED_ONLY)
+            )
+            block = (
+                not act and not waitlist and not inflight and not can_resume
+            )
+            poll_s = 0.05 if (block and self._suspended) else None
             first_intake = block
             while True:
                 try:
-                    item = self._inbox.get(block=block)
+                    item = self._inbox.get(block=block, timeout=poll_s)
                 except _queue.Empty:
                     break
                 block = False
@@ -3992,6 +4488,34 @@ class ContinuousBatcher:
                                "hbm_headroom_frac": headroom_frac,
                                "device_ms": self.stats.device_time_snapshot()["ms"]},
                     )
+                if bo.level == SHED_ONLY and lvl_before < SHED_ONLY:
+                    # swap-don't-shed on the incident edge: park the
+                    # youngest streams on the host tier so the survivors
+                    # keep full decode width; they resume once the level
+                    # drops back below SHED_ONLY (resume_suspended gates
+                    # on it)
+                    target = bo.suspend_target(self.max_slots)
+                    while suspend_on:
+                        live = [
+                            i for i, r in enumerate(self._slots)
+                            if isinstance(r, _Request)
+                        ]
+                        if len(live) <= target:
+                            break
+                        victim = max(
+                            live, key=lambda i: self._slots[i].t_admit
+                        )
+                        if not suspend_slot(victim, "brownout"):
+                            break
+            if tier is not None and paged:
+                # proactive demotion: keep ~demote_free_frac of the pool
+                # free by demoting cold cache chunks to the host tier
+                # BETWEEN bursts, so admissions stop paying the reclaim at
+                # the worst moment (and the tier fills before pressure
+                # peaks). No-op once the cache holds nothing unpinned.
+                floor_blocks = int(pool.n_blocks * tier.demote_free_frac)
+                if pool.free_blocks < floor_blocks:
+                    pc.reclaim(floor_blocks - pool.free_blocks, demote=True)
             # deadline sweep, queued side: waiters whose budget already ran
             # out — or whose remaining budget the live rate EWMAs say cannot
             # cover prefill plus the token floor — are shed BEFORE any
@@ -4040,6 +4564,43 @@ class ContinuousBatcher:
                         ))
                     except Exception:  # noqa: BLE001 — dead client loop
                         pass
+            # deadline sweep, suspended side: a parked slot's clock keeps
+            # running — an expired one is failed right here with the same
+            # retryable deadline cause (it holds no pool blocks, so there
+            # is nothing to free), and a cancelled one is dropped
+            if self._suspended:
+                kept_s = []
+                for srec in self._suspended:
+                    r = srec.req
+                    if r.cancelled:
+                        self._ledger_finalize(
+                            r,
+                            "deadline_abort" if r.deadline_hit else "cancelled",
+                        )
+                        self.stats.record_cancel("active")
+                        continue
+                    if r.deadline is not None and now > r.deadline:
+                        r.deadline_hit = True
+                        waited_ms = (now - r.t_enq) * 1e3
+                        self.stats.record_shed(
+                            "deadline", waited_ms=waited_ms
+                        )
+                        self._suspend_stats["suspended_deadline_expired"] += 1
+                        self._ledger_finalize(r, "deadline_abort")
+                        try:
+                            r.emit("err", BatcherOverloaded(
+                                f"deadline exceeded while suspended after "
+                                f"{r.generated} tokens; retry on another "
+                                f"worker"
+                            ))
+                        except Exception:  # noqa: BLE001 — dead client loop
+                            pass
+                        continue
+                    kept_s.append(srec)
+                self._suspended = kept_s
+            # resume parked slots BEFORE admitting new waiters: they are
+            # strictly older work and already hold their first tokens
+            resume_suspended()
             self._wl_len = len(waitlist)
             # admit waiters: bursts of short same-bucket prompts go through
             # one batched dispatch; runs of LONG prompts go through one
@@ -4336,6 +4897,7 @@ class ContinuousBatcher:
             req.emit("tok", (tok_id, logprob, top_ids, top_lps))
         else:
             req.emit("tok", tok_id)
+        req.emitted.append(int(tok_id))
         if req.generated >= req.sp.max_tokens or req.pos + 1 >= self.max_seq:
             if req.trace is not None:
                 req.trace.mark("decode_done")
@@ -4360,6 +4922,13 @@ class ContinuousBatcher:
                 req.emit("end", reason)
             if req is not None:  # includes _RESERVED placeholders
                 self._slots[i] = None
+        for rec in self._suspended:
+            # suspended slots are live requests parked on the host tier;
+            # a drain fails them exactly like active slots (their streamed
+            # tokens were served, the rest retries elsewhere)
+            self._ledger_finalize(rec.req, "served")
+            rec.req.emit("end", reason)
+        self._suspended = []
         while True:
             try:
                 req = self._inbox.get_nowait()

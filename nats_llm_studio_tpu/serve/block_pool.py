@@ -45,6 +45,10 @@ class BlockPool:
         self._lock = threading.Lock()
         self.epoch = 0
         self.cow_copies = 0
+        # high-water mark of live (non-free) blocks since the last reset:
+        # the pressure signal the tiering bench reads to prove a working
+        # set really exceeded the pool, not just the prefix budget
+        self.peak_live = 0
         self._refs = [0] * self.n_blocks
         self._refs[0] = 1  # the null block is never allocatable
         self._free = list(range(self.n_blocks - 1, 0, -1))  # pop() -> low ids first
@@ -59,6 +63,9 @@ class BlockPool:
             ids = [self._free.pop() for _ in range(k)]
             for i in ids:
                 self._refs[i] = 1
+            live = self.n_blocks - 1 - len(self._free)
+            if live > self.peak_live:
+                self.peak_live = live
             return ids
 
     def incref(self, ids) -> None:
@@ -97,6 +104,7 @@ class BlockPool:
         with self._lock:
             self.epoch += 1
             self.cow_copies = 0
+            self.peak_live = 0
             self._refs = [0] * self.n_blocks
             self._refs[0] = 1
             self._free = list(range(self.n_blocks - 1, 0, -1))
@@ -117,6 +125,7 @@ class BlockPool:
                 "blocks_free": len(self._free),
                 "blocks_live": live,
                 "blocks_shared": shared,
+                "blocks_peak_live": self.peak_live,
                 "block_tokens": self.block_tokens,
                 "cow_copies": self.cow_copies,
                 "epoch": self.epoch,

@@ -58,6 +58,11 @@ class BrownoutConfig:
     dwell_s: float = 2.0       # calm required before stepping back down
     shed_only_scale: float = 1.5
     tighten_frac: float = 0.5  # effective admit-limit fraction in brownout
+    # SHED_ONLY slot target as a fraction of max_slots: with tiered KV +
+    # slot suspend enabled the batcher suspends (not cancels) the youngest
+    # slots down to this fraction on the SHED_ONLY edge, freeing pool blocks
+    # and decode width for the oldest streams without losing any work
+    suspend_frac: float = 0.5
 
 
 class BrownoutController:
@@ -155,3 +160,11 @@ class BrownoutController:
         if max_queue and self.level >= BROWNOUT:
             return max(1, int(max_queue * self.cfg.tighten_frac))
         return max_queue
+
+    def suspend_target(self, max_slots: int) -> int:
+        """Slot-count target for the suspend lever: below it, the batcher
+        stops suspending. Only binds in SHED_ONLY; resume is gated on the
+        level dropping back below SHED_ONLY."""
+        if self.level >= SHED_ONLY:
+            return max(1, int(max_slots * self.cfg.suspend_frac))
+        return max_slots

@@ -129,6 +129,30 @@ class DataParallelBatcher:
     def drop_prefix_cache(self) -> int:
         return sum(r.drop_prefix_cache() for r in self.replicas)
 
+    def suspend_harvest_to_cache(self, timeout: float = 30.0) -> dict:
+        """Every replica harvests its own slots (disjoint slot tables)."""
+        out = {"slots": 0, "tokens": 0}
+        for r in self.replicas:
+            got = r.suspend_harvest_to_cache(timeout=timeout)
+            out["slots"] += int(got.get("slots", 0))
+            out["tokens"] += int(got.get("tokens", 0))
+        return out
+
+    def tier_stats(self) -> dict | None:
+        """Numeric tier/suspend counters summed across replicas (None when
+        no replica has tiering or suspend on) — advert + metrics surface."""
+        merged: dict | None = None
+        for r in self.replicas:
+            s = r.tier_stats()
+            if not s:
+                continue
+            if merged is None:
+                merged = {}
+            for k, v in s.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    merged[k] = merged.get(k, 0) + v
+        return merged
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:

@@ -109,6 +109,38 @@ def _kv_pool_blocks_env(default: int = 0) -> int:
     return default
 
 
+def _kv_host_pool_bytes_env(default: int = 256 << 20) -> int:
+    """Host-RAM KV tier budget in bytes (serve/kv_tiers.py,
+    ``KV_HOST_POOL_BYTES``). 0 disables tiering — demoted prefix chunks
+    are dropped instead of swapped to host memory."""
+    env = os.environ.get("KV_HOST_POOL_BYTES", "").strip()
+    if env:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            log.warning("ignoring non-integer KV_HOST_POOL_BYTES=%r", env)
+    return default
+
+
+def _kv_tier_policy_env() -> tuple[int, float, int]:
+    """(promote_chunks, demote_free_frac, spill_max_objects) from the env
+    — the KVTierManager policy knobs (KV_PROMOTE_CHUNKS /
+    KV_DEMOTE_FREE_FRAC / KV_SPILL_MAX_OBJECTS)."""
+    try:
+        promote = max(1, int(os.environ.get("KV_PROMOTE_CHUNKS", "").strip() or 64))
+    except ValueError:
+        promote = 64
+    try:
+        frac = float(os.environ.get("KV_DEMOTE_FREE_FRAC", "").strip() or 0.10)
+    except ValueError:
+        frac = 0.10
+    try:
+        max_obj = max(1, int(os.environ.get("KV_SPILL_MAX_OBJECTS", "").strip() or 512))
+    except ValueError:
+        max_obj = 512
+    return promote, min(max(frac, 0.0), 0.9), max_obj
+
+
 def _spec_decode_env(default_k: int = 6) -> tuple[int, int]:
     """(spec_decode_k, spec_max_active) from the env (serve/spec.py).
     ``SPEC_DECODE=0`` (or false/off) is the hard off-switch; otherwise
@@ -641,6 +673,8 @@ class LocalRegistry(Registry):
         obs_dump_dir: str | None = None,
         worker_id: str = "",
         pull_precompile: bool | None = None,
+        kv_host_pool_bytes: int | None = None,
+        kv_spill_factory=None,
     ):
         self.store = store
         self.mesh = mesh
@@ -688,6 +722,19 @@ class LocalRegistry(Registry):
             if kv_pool_blocks is not None
             else _kv_pool_blocks_env()
         )
+        # hierarchical KV tiers (serve/kv_tiers.py): host-RAM tier budget
+        # under the HBM block pool (0 disables tiering entirely).
+        # kv_spill_factory() returns a SpillStore adapter for the cold
+        # Object Store tier — the worker injects one over its JetStream
+        # connection; None keeps the host tier terminal (no cold spill).
+        self.kv_host_pool_bytes = (
+            kv_host_pool_bytes
+            if kv_host_pool_bytes is not None
+            else _kv_host_pool_bytes_env()
+        )
+        self.kv_spill_factory = kv_spill_factory
+        (self.kv_promote_chunks, self.kv_demote_free_frac,
+         self.kv_spill_max_objects) = _kv_tier_policy_env()
         # prefill chunk size handed to every batcher (None = the batcher
         # default, clamped to max_seq_len). Tiny serving setups — tests and
         # the disagg bench — need small chunks so a short prompt still
@@ -1268,7 +1315,7 @@ class LocalRegistry(Registry):
                 from ..parallel.sharding import shard_params
 
                 rep_params = shard_params(params, sub, cfg)
-            replicas.append(ContinuousBatcher(
+            b = ContinuousBatcher(
                 rep_params, cfg, max_slots=self.max_batch_slots,
                 max_seq_len=self.max_seq_len,
                 mesh=sub, max_queue=self.admit_queue_limit,
@@ -1285,7 +1332,41 @@ class LocalRegistry(Registry):
                 recorder=recorder,
                 **({"prefill_chunk": self.prefill_chunk}
                    if self.prefill_chunk else {}),
-            ))
+            )
+            # hierarchical KV tier manager, attached AFTER construction so
+            # chunk_tokens matches the batcher's (possibly halved) prefill
+            # chunk exactly — the tier is keyed by whole prefix-cache
+            # chunks, and a mismatch would poison every demote/promote.
+            # Per-replica managers: demote/promote stay owner-thread-local,
+            # and per-replica spill namespaces keep the Object Store index
+            # single-writer.
+            if (
+                self.kv_host_pool_bytes > 0
+                and b.paged
+                and b.prefix_cache is not None
+            ):
+                from .kv_tiers import KVTierManager
+
+                spill = None
+                if self.kv_spill_factory is not None:
+                    try:
+                        spill = self.kv_spill_factory()
+                    except Exception:  # noqa: BLE001
+                        log.warning(
+                            "kv spill store unavailable for %s; host tier "
+                            "only", model_id, exc_info=True,
+                        )
+                ns = f"kv/{model_id}" if n_dp == 1 else f"kv/{model_id}/dp{i}"
+                b.kv_tiers = KVTierManager(
+                    self.kv_host_pool_bytes,
+                    chunk_tokens=b.prefill_chunk,
+                    spill=spill,
+                    namespace=ns,
+                    max_spill_objects=self.kv_spill_max_objects,
+                    promote_chunks=self.kv_promote_chunks,
+                    demote_free_frac=self.kv_demote_free_frac,
+                )
+            replicas.append(b)
         if n_dp > 1:
             from .dp import DataParallelBatcher
 
@@ -1300,6 +1381,24 @@ class LocalRegistry(Registry):
             n_warm = batcher.warm_chunk_programs()
             log.info("warmed %d prefill programs for %s", n_warm, model_id)
         batcher.start()
+        # restart-with-warm-cache: the Object Store tier survived the old
+        # process, so re-import the deepest spilled chains without a live
+        # donor. Best-effort — a full pool or a torn blob just means this
+        # engine starts cold, exactly like before tiering existed.
+        for r in replicas:
+            tier = getattr(r, "kv_tiers", None)
+            if tier is None:
+                continue
+            warmed = 0
+            for export in tier.warm_exports(limit=4):
+                try:
+                    warmed += int(r.import_prefix_blocks(export).get("tokens", 0))
+                except Exception:  # noqa: BLE001
+                    break
+            if warmed:
+                log.info("warm-imported %d cached prefix tokens for %s",
+                         warmed, model_id)
+                obs_emit("kv_warm_import", model=model_id, tokens=warmed)
         load_s = time.perf_counter() - t0
         log.info("loaded %s in %.1fs (%s, %s)", model_id, load_s, cfg.arch, self.dtype)
         obs_emit("engine_load", model=model_id, seconds=round(load_s, 2),

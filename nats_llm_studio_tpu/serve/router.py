@@ -187,6 +187,7 @@ class WorkerAdvert:
     hbm_headroom: float = 1.0
     mesh: dict = field(default_factory=dict)  # named axis factoring, e.g. {"dp": 2, "tp": 2}
     models: tuple[str, ...] = ()
+    kv_tier_depth: int = 0  # host-tier KV entries (warm-cache tiebreak)
     draining: bool = False
     heads: frozenset[str] = frozenset()
     seq: int = 0
@@ -227,6 +228,7 @@ class WorkerAdvert:
             hbm_headroom=float(d.get("hbm_headroom", 1.0)),
             mesh=dict(mesh) if isinstance(mesh, dict) else {},
             models=tuple(m for m in d.get("models") or () if isinstance(m, str)),
+            kv_tier_depth=int(d.get("kv_tier_depth") or 0),
             draining=bool(d.get("draining")),
             heads=frozenset(h for h in d.get("heads") or () if isinstance(h, str)),
             seq=int(d.get("seq") or 0),
@@ -414,6 +416,7 @@ class ClusterRouter:
                 0 if (not long_prompt or m.sp_degree > 1) else 1,
                 m.load,  # depth per advertised slot: dp replicas count
                 m.queue_depth,
+                -m.kv_tier_depth,  # equal load: prefer the warmer KV tier
                 m.worker_id,  # total order: deterministic under ties
             )
             if best is None or key < best:
@@ -432,6 +435,7 @@ class ClusterRouter:
                     0 if (not long_prompt or m.sp_degree > 1) else 1,
                     m.load,
                     m.queue_depth,
+                    -m.kv_tier_depth,
                     m.worker_id,
                 )
                 if pbest is None or pkey < pbest:

@@ -48,6 +48,7 @@ from ..obs import (
 )
 from ..transport.client import Msg, NatsClient, connect
 from ..transport.envelope import deadline_remaining_s, envelope_error, envelope_ok
+from ..transport.jetstream import ObjectStoreError
 from ..transport.protocol import (
     ATTEMPT_HEADER,
     DEADLINE_HEADER,
@@ -115,6 +116,86 @@ else:
             handle.cancel()
 
 
+class _ObjectStoreSpill:
+    """Sync ``SpillStore`` adapter over the worker's JetStream Object Store
+    (bucket ``kv-tier``) for serve/kv_tiers.py: the tier manager's spill
+    thread calls put/get/delete, each marshalled onto the worker's asyncio
+    loop with ``run_coroutine_threadsafe``. Unlike ``kv-transfer`` blobs the
+    bucket is NOT single-use — it is the cold KV tier that survives process
+    death, which is the whole restart-with-warm-cache story."""
+
+    _PROBE_TIMEOUT_S = 2.0
+
+    def __init__(self, nc, loop, timeout: float = 10.0):
+        from ..transport.jetstream import ObjectStore
+
+        self._store = ObjectStore(nc, timeout=timeout)
+        self._loop = loop
+        self._timeout = timeout
+        self._bucket = "kv-tier"
+        # availability probe, kicked off NOW but never awaited on the hot
+        # path: the broker has no no-responders signalling, so a deployment
+        # without the object-store module (bare EmbeddedBroker in tests,
+        # core-NATS-only brokers) would otherwise stall the full transfer
+        # timeout on every call — 10s added to engine load via
+        # warm_exports, 10s per spill attempt. One short STREAM.CREATE,
+        # latched both ways: ready, or dead for the process (host tier
+        # stays, cold tier off).
+        probe_t = min(self._PROBE_TIMEOUT_S, timeout)
+        probe = ObjectStore(nc, timeout=probe_t)
+
+        async def _probe_once() -> bool:
+            try:
+                await probe.ensure_bucket(self._bucket)
+                return True
+            except Exception as e:  # noqa: BLE001 — any failure = no tier
+                log.warning(
+                    "kv-tier object store unreachable (%s); cold KV spill "
+                    "disabled for this process", type(e).__name__,
+                )
+                return False
+
+        self._probe_fut = asyncio.run_coroutine_threadsafe(_probe_once(), loop)
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            self._timeout + 5.0
+        )
+
+    def _alive(self, wait: bool) -> bool:
+        """Probe verdict. ``wait=False`` (read path: engine-load warm
+        restore, promotion fetches) treats an unresolved probe as dead-for-
+        now so lookups degrade to instant misses; ``wait=True`` (the tier
+        manager's background spill thread) blocks for the verdict."""
+        try:
+            if wait:
+                return bool(self._probe_fut.result(self._PROBE_TIMEOUT_S + 5.0))
+            return self._probe_fut.done() and bool(self._probe_fut.result(0))
+        except Exception:  # noqa: BLE001 — cancelled/timed out probe = dead
+            return False
+
+    def put(self, name: str, data: bytes) -> None:
+        if not self._alive(wait=True):
+            raise ObjectStoreError("kv-tier object store unavailable")
+        self._run(self._store.put(self._bucket, name, data))
+
+    def get(self, name: str) -> bytes | None:
+        from ..transport.jetstream import ObjectNotFound
+
+        if not self._alive(wait=False):
+            return None  # no (confirmed) cold tier: same as a miss
+        try:
+            return self._run(self._store.get(self._bucket, name))
+        except ObjectNotFound:
+            return None  # never spilled, or pruned: a clean miss
+
+    def delete(self, name: str) -> None:
+        if not self._alive(wait=False):
+            return
+        with contextlib.suppress(Exception):
+            self._run(self._store.delete(self._bucket, name))
+
+
 class Worker:
     """One serving process: NATS subscriptions + an in-process model registry."""
 
@@ -179,6 +260,21 @@ class Worker:
             reconnect_max_wait_s=cfg.reconnect_max_wait_s,
             ping_interval_s=cfg.ping_interval_s,
         )
+        # cold KV tier (serve/kv_tiers.py): hand the registry a spill-store
+        # factory over this connection so engine loads can give their tier
+        # managers an Object Store behind the host-RAM tier. Late-bound —
+        # the registry is constructed before the connection exists; a
+        # registry without tiering (or tests' fakes) never passes the gate.
+        if (
+            getattr(cfg, "kv_spill_objstore", True)
+            and getattr(self.registry, "kv_host_pool_bytes", 0) > 0
+            and getattr(self.registry, "kv_spill_factory", None) is None
+        ):
+            loop = asyncio.get_running_loop()
+            nc, spill_t = self.nc, cfg.kv_transfer_timeout_s
+            self.registry.kv_spill_factory = (
+                lambda: _ObjectStoreSpill(nc, loop, timeout=spill_t)
+            )
         q = cfg.queue_group
         subs = {
             cfg.subject("list_models"): self.on_list_models,
@@ -277,6 +373,7 @@ class Worker:
         depth = 0
         brownout = 0
         slots = 0
+        tier_depth = 0
         for eng in self.registry.loaded_engines().values():
             b = getattr(eng, "batcher", None)
             if b is None:
@@ -284,6 +381,17 @@ class Worker:
             depth += int(getattr(b, "queue_depth", 0) or 0)
             slots += int(getattr(b, "max_slots", 0) or 0)
             brownout = max(brownout, int(getattr(b, "brownout_level", 0) or 0))
+            # warm-KV depth (router tiebreak): host-tier entries held by
+            # this worker's engines — a deeper tier serves repeat prefixes
+            # without recompute, so equal-load routing prefers it
+            tier_fn = getattr(b, "tier_stats", None)
+            if tier_fn is not None:
+                try:
+                    ts = tier_fn()
+                except Exception:  # noqa: BLE001 — adverts never crash
+                    ts = None
+                if ts:
+                    tier_depth += int(ts.get("host_entries", 0) or 0)
         headroom_fn = getattr(self.registry, "_hbm_headroom_frac", None)
         try:
             headroom = float(headroom_fn()) if headroom_fn is not None else 1.0
@@ -299,6 +407,7 @@ class Worker:
             "hbm_headroom": round(headroom, 4),
             "mesh": dict(mesh.shape) if mesh is not None else {},
             "models": sorted(self.registry.loaded_engines()),
+            "kv_tier_depth": tier_depth,
             "draining": self.draining,
             "heads": self._recent_heads.snapshot(),
             "seq": self._advert_seq,
@@ -404,6 +513,25 @@ class Worker:
                 )
                 break
             await asyncio.sleep(0.05)
+        # zero-lost-work preemption: fold every still-running slot's full
+        # token history (prompt + generated so far) into its prefix cache
+        # BEFORE the handoff export below, so in-progress work ships to the
+        # survivor too and the client's retry resumes as a prefix hit
+        # instead of re-prefilling (and re-decoding) from scratch. No-op on
+        # idle engines; best-effort — a failure falls back to the plain
+        # retryable-drain envelope the stop() below produces anyway.
+        harvested = {"slots": 0, "tokens": 0}
+        for mid, eng in list(self.registry.loaded_engines().items()):
+            b = getattr(eng, "batcher", None)
+            harvest = getattr(b, "suspend_harvest_to_cache", None)
+            if harvest is None or not getattr(b, "alive", False):
+                continue
+            try:
+                got = await asyncio.to_thread(harvest)
+                harvested["slots"] += int(got.get("slots", 0))
+                harvested["tokens"] += int(got.get("tokens", 0))
+            except Exception:  # noqa: BLE001
+                log.warning("suspend-harvest failed for %s", mid, exc_info=True)
         handoff: dict | None = None
         if handoff_to and handoff_to != self.worker_id:
             # after the busy-wait, before the batcher stops: the cache
@@ -428,6 +556,8 @@ class Worker:
             "stopped_engines": stopped,
             "deadline_s": deadline_s,
         }
+        if harvested["slots"]:
+            result["harvested"] = harvested
         if handoff is not None:
             result["handoff"] = handoff
         return result
@@ -1685,6 +1815,35 @@ class Worker:
                 # rides the generic histograms() loop below
                 for name, v in stats.spec_counters().items():
                     r.counter(f"lmstudio_spec_{name}_total", v, labels=labels)
+            tier_fn = getattr(rb, "tier_stats", None)
+            tier = tier_fn() if tier_fn is not None else None
+            if tier:
+                # hierarchical KV tier + slot suspend/resume families
+                # (serve/kv_tiers.py): gauges describe the host tier's
+                # current occupancy, counters the chunk traffic between
+                # tiers and the swap-don't-shed slot movements
+                for name in ("host_entries", "host_bytes",
+                             "host_budget_bytes", "spill_pending"):
+                    if name in tier:
+                        r.gauge(f"lmstudio_kv_tier_{name}", tier[name],
+                                labels=labels)
+                r.gauge("lmstudio_kv_tier_suspended_slots",
+                        tier.get("suspended", 0), labels=labels,
+                        help="slots currently swapped out to the host tier "
+                             "awaiting resume")
+                for name in ("demoted_chunks", "promoted_chunks",
+                             "demote_failures", "host_hits", "host_misses",
+                             "spilled_blobs", "fetched_blobs",
+                             "spill_failures", "fetch_failures",
+                             "demoted_blocks", "suspended_total",
+                             "resumed_total", "suspend_failures",
+                             "suspended_deadline_expired"):
+                    if name in tier:
+                        # stat keys like suspended_total already carry the
+                        # suffix; strip it so the family never doubles up
+                        base = name[:-6] if name.endswith("_total") else name
+                        r.counter(f"lmstudio_kv_tier_{base}_total",
+                                  tier[name], labels=labels)
             for name, h in stats.histograms().items():
                 r.histogram(f"lmstudio_{name}", h.snapshot(), labels=labels)
             if hasattr(stats, "program_histograms"):

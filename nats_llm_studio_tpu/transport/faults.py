@@ -45,8 +45,11 @@ log = logging.getLogger(__name__)
 BROKER_PUBLISH = "broker.publish"  # a client's PUB/HPUB arriving at the broker
 PUMP = "batcher.pump"              # one batcher owner-loop iteration
 CLIENT_CONNECT = "client.connect"  # one NatsClient dial attempt (incl. reconnects)
+TIER_SPILL = "tier.spill"          # one host-tier → Object Store blob write
+TIER_FETCH = "tier.fetch"          # one Object Store → host-tier blob read
+SUSPEND = "batcher.suspend"        # one slot suspend attempt (swap-don't-shed)
 
-SITES = (BROKER_PUBLISH, PUMP, CLIENT_CONNECT)
+SITES = (BROKER_PUBLISH, PUMP, CLIENT_CONNECT, TIER_SPILL, TIER_FETCH, SUSPEND)
 KINDS = ("sever", "drop", "delay", "raise")
 
 
