@@ -1424,6 +1424,251 @@ def kv_tiering_bench(cfg, params, model_id: str, *, seq: int | None = None,
 
 
 # ---------------------------------------------------------------------------
+# multi-tenant QoS: 3-class overload fairness + preempt vs shed-retry
+# ---------------------------------------------------------------------------
+
+
+def qos_bench(cfg, params, model_id: str = "bench/qos", *,
+              slots: int | None = None, n_each: int | None = None,
+              max_new: int | None = None) -> dict:
+    """Multi-tenant QoS plane (serve/qos.py + batcher admission), driven at
+    the batcher seam where the policy lives. Two sub-phases:
+
+    * mix — a 3-class overload (batch/standard/premium tenants, interleaved
+      arrival, queue bound far under the offered load) vs a premium-only
+      solo baseline of identical geometry. DRR admission must keep premium
+      p95 TTFT within ``BENCH_QOS_TTFT_FACTOR`` (default 1.25) of solo,
+      with ZERO premium sheds — 100% of the shed lands on batch/standard
+      (the depth + fair_share causes).
+    * preempt — a premium admit against a full KV pool: with preemption ON
+      the batch victim parks on the host tier (resuming bit-identically)
+      and premium serves immediately; with slot-suspend OFF the premium
+      request takes the kv_pool shed and retries until the pool frees.
+      The wall-clock ratio is the cost of shed-retry the preempt path
+      removes."""
+    import asyncio
+
+    from nats_llm_studio_tpu.engine.generator import SamplingParams
+    from nats_llm_studio_tpu.serve.batcher import (
+        BatcherOverloaded,
+        ContinuousBatcher,
+    )
+    from nats_llm_studio_tpu.transport.envelope import shed_cause_of
+
+    slots = slots or int(os.environ.get("BENCH_QOS_SLOTS", "2"))
+    n_each = n_each or int(os.environ.get("BENCH_QOS_REQS", "6"))
+    max_new = max_new or int(os.environ.get("BENCH_QOS_NEW", "8"))
+    prompt_len = int(os.environ.get("BENCH_QOS_PROMPT", "48"))
+    max_queue = int(os.environ.get("BENCH_QOS_QUEUE", "8"))
+    ttft_factor = float(os.environ.get("BENCH_QOS_TTFT_FACTOR", "1.25"))
+
+    def toks(i: int) -> list[int]:
+        return [(j * 7 + 3 + i * 13) % 509 for j in range(prompt_len)]
+
+    async def timed_submit(b, prompt, tenant, priority, n_new):
+        sp = SamplingParams(temperature=0.0, max_tokens=n_new)
+        t0 = time.perf_counter()
+        ttft = None
+        out = []
+        try:
+            async for t in b.submit(prompt, sp, tenant=tenant,
+                                    priority=priority):
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                out.append(t)
+        except BatcherOverloaded as e:
+            return {"ok": False, "tenant": tenant,
+                    "cause": shed_cause_of(str(e)) or "overload"}
+        return {"ok": True, "tenant": tenant, "tokens": out,
+                "ttft_ms": round((ttft or 0.0) * 1e3, 2),
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
+    def mix_batcher() -> ContinuousBatcher:
+        return ContinuousBatcher(
+            params, cfg, max_slots=slots, max_seq_len=64 + prompt_len,
+            buckets=[64 + prompt_len], max_queue=max_queue,
+            admit_coalesce_ms=25.0,
+        )
+
+    # -- mix: premium-only solo baseline, then the 3-class overload ----------
+    async def run_solo():
+        b = mix_batcher()
+        try:
+            await timed_submit(b, toks(99), "warm", "standard", 2)
+            rs = await asyncio.gather(*[
+                timed_submit(b, toks(i), "acme", "premium", max_new)
+                for i in range(n_each)
+            ])
+            return sorted(r["ttft_ms"] for r in rs if r["ok"])
+        finally:
+            b.stop()
+
+    async def run_overload():
+        b = mix_batcher()
+        try:
+            await timed_submit(b, toks(99), "warm", "standard", 2)
+            jobs = []
+            for i in range(n_each):
+                jobs.append(("hobby", "batch", toks(100 + i)))
+                jobs.append(("corp", "standard", toks(200 + i)))
+                jobs.append(("acme", "premium", toks(i)))
+            rs = await asyncio.gather(*[
+                timed_submit(b, p, t, c, max_new) for t, c, p in jobs
+            ])
+            snap = b.tenant_stats.snapshot()
+            return rs, snap, dict(b.stats.shed_cause_counts())
+        finally:
+            b.stop()
+
+    solo_ttfts = asyncio.run(run_solo())
+    gc.collect()
+    results, tenants, causes = asyncio.run(run_overload())
+    gc.collect()
+    prem = [r for r in results if r["tenant"] == "acme"]
+    prem_ttfts = sorted(r["ttft_ms"] for r in prem if r["ok"])
+    shed_by_tenant = {t: row["shed"] for t, row in tenants.items()
+                      if row["shed"]}
+    if [r for r in prem if not r["ok"]] or shed_by_tenant.get("acme", 0):
+        raise RuntimeError(
+            f"premium was shed under the 3-class overload: {shed_by_tenant} "
+            "(shed must land on batch/standard only)"
+        )
+    if sum(shed_by_tenant.values()) <= 0:
+        raise RuntimeError(
+            "overload mix shed nothing — the phase measured no contention "
+            f"(causes: {causes})"
+        )
+    solo_p95 = _pctl(solo_ttfts, 0.95)
+    prem_p95 = _pctl(prem_ttfts, 0.95)
+    ratio = round(prem_p95 / solo_p95, 3) if solo_p95 else 0.0
+    if solo_p95 and ratio > ttft_factor:
+        raise RuntimeError(
+            f"premium p95 TTFT degraded {ratio}x vs solo under overload "
+            f"(bound {ttft_factor}x): solo {solo_p95:.1f} ms, "
+            f"mix {prem_p95:.1f} ms"
+        )
+    mix = {
+        "offered_per_class": n_each,
+        "solo_ttft_p95_ms": round(solo_p95, 2),
+        "premium_ttft_p95_ms": round(prem_p95, 2),
+        "premium_ttft_ratio": ratio,
+        "premium_served": sum(1 for r in prem if r["ok"]),
+        "shed_by_tenant": shed_by_tenant,
+        "shed_by_cause": causes,
+        "served_by_tenant": {t: row["served"] for t, row in tenants.items()},
+    }
+
+    # -- preempt: premium admit on a full pool, preempt ON vs suspend OFF ----
+    pre_kw = dict(max_slots=2, max_seq_len=64, buckets=[8, 64],
+                  prefill_chunk=32, kv_block_tokens=32, kv_pool_blocks=3,
+                  decode_burst=1, admit_coalesce_ms=0.0, paged=True)
+    pa = [(j * 7 + 3) % 509 for j in range(33)]
+    pb = [(j * 11 + 5) % 509 for j in range(40)]
+    na, nb = 12, 8
+
+    async def serve_plain(b, prompt, n_new):
+        sp = SamplingParams(temperature=0.0, max_tokens=n_new)
+        return [t async for t in b.submit(prompt, sp)]
+
+    ample = ContinuousBatcher(params, cfg, **{**pre_kw, "kv_pool_blocks": 0})
+    try:
+        want_a = asyncio.run(serve_plain(ample, pa, na))
+    finally:
+        ample.stop()
+    gc.collect()
+
+    async def pressure(b, retry_b: bool):
+        """A (batch) decodes first; once 2 tokens arrive, B (premium)
+        lands on the exhausted pool. ``retry_b`` = client-side retry loop
+        for the shed-mode engine."""
+        spa = SamplingParams(temperature=0.0, max_tokens=na)
+        spb = SamplingParams(temperature=0.0, max_tokens=nb)
+        started = asyncio.get_running_loop().create_future()
+
+        async def run_a():
+            t0 = time.perf_counter()
+            out = []
+            async for t in b.submit(pa, spa, tenant="hobby",
+                                    priority="batch"):
+                out.append(t)
+                if len(out) == 2 and not started.done():
+                    started.set_result(None)
+            return out, (time.perf_counter() - t0) * 1e3
+
+        async def run_b():
+            t0 = time.perf_counter()
+            retries = 0
+            while True:
+                try:
+                    out = [t async for t in b.submit(
+                        pb, spb, tenant="acme", priority="premium")]
+                    return out, (time.perf_counter() - t0) * 1e3, retries
+                except BatcherOverloaded:
+                    if not retry_b:
+                        raise
+                    retries += 1
+                    await asyncio.sleep(0.025)
+
+        ta = asyncio.ensure_future(run_a())
+        await started
+        tb = asyncio.ensure_future(run_b())
+        (a_toks, a_ms), (b_toks, b_ms, retries) = await asyncio.gather(ta, tb)
+        return a_toks, a_ms, b_ms, retries
+
+    b_on = ContinuousBatcher(params, cfg, **{**pre_kw, "qos_preempt": True})
+    try:
+        a_toks, a_on_ms, b_on_ms, _ = asyncio.run(pressure(b_on, False))
+        preempted = b_on.tenant_stats.snapshot().get(
+            "hobby", {}).get("preempted", 0)
+        on_sheds = dict(b_on.stats.shed_cause_counts())
+    finally:
+        b_on.stop()
+    gc.collect()
+    if preempted < 1:
+        raise RuntimeError("premium admit on a full pool preempted nothing")
+    if on_sheds.get("kv_pool", 0):
+        raise RuntimeError(
+            f"preempt mode shed {on_sheds['kv_pool']}x on kv_pool — "
+            "preempt-to-host-tier is broken"
+        )
+    if a_toks != want_a:
+        raise RuntimeError(
+            "preempted batch slot did not resume bit-identically "
+            f"({len(a_toks)} vs {len(want_a)} tokens)"
+        )
+
+    b_off = ContinuousBatcher(params, cfg, **{**pre_kw, "kv_suspend": False})
+    try:
+        _, a_off_ms, b_off_ms, retries = asyncio.run(pressure(b_off, True))
+        off_sheds = dict(b_off.stats.shed_cause_counts())
+    finally:
+        b_off.stop()
+    gc.collect()
+    if off_sheds.get("kv_pool", 0) < 1:
+        raise RuntimeError(
+            "shed-retry mode never shed on kv_pool — the comparison "
+            f"measured nothing (causes: {off_sheds})"
+        )
+
+    return {
+        "mix": mix,
+        "preempt": {
+            "victim_resumed_bit_identical": True,
+            "victims_preempted": preempted,
+            "premium_wall_preempt_ms": round(b_on_ms, 1),
+            "premium_wall_shed_retry_ms": round(b_off_ms, 1),
+            "shed_retry_attempts": retries,
+            "shed_retry_cost_ratio": (
+                round(b_off_ms / b_on_ms, 2) if b_on_ms else 0.0
+            ),
+            "victim_wall_preempt_ms": round(a_on_ms, 1),
+            "victim_wall_shed_mode_ms": round(a_off_ms, 1),
+            "kv_pool_sheds_shed_mode": off_sheds.get("kv_pool", 0),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # speculative decoding: prompt-lookup drafts, spec ON vs OFF
 # ---------------------------------------------------------------------------
 
@@ -3854,6 +4099,13 @@ def main() -> None:
                 cfg, params, "bench/tiny",
                 seq=256, chunk=64, slots=2, n_prompts=10, max_new=8,
             ))
+        if os.environ.get("BENCH_QOS", "1") != "0":
+            # micro-run of the multi-tenant QoS phase: 3-class overload
+            # fairness (premium TTFT held, shed confined to batch/standard)
+            # + preempt-to-host-tier vs shed-retry on a full pool
+            _run_phase(tiny_detail, "qos", lambda: qos_bench(
+                cfg, params, "bench/tiny", slots=2, n_each=4, max_new=8,
+            ))
         if os.environ.get("BENCH_DECODE_KERNEL", "1") != "0":
             # micro-run of the decode-kernel phase: forced Pallas runs in
             # interpreter mode on CPU, so the smoke proves greedy parity
@@ -4036,6 +4288,13 @@ def main() -> None:
     # -- KV tiering: swap-don't-shed at 10x the prefix budget, ON vs OFF ----
     if os.environ.get("BENCH_KV_TIER", "1") != "0":
         _run_phase(detail, "kv_tiering", lambda: kv_tiering_bench(
+            cfg, params, "bench/llama3-8b"
+        ))
+        gc.collect()
+
+    # -- multi-tenant QoS: 3-class fairness + preempt vs shed-retry ----------
+    if os.environ.get("BENCH_QOS", "1") != "0":
+        _run_phase(detail, "qos", lambda: qos_bench(
             cfg, params, "bench/llama3-8b"
         ))
         gc.collect()

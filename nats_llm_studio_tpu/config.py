@@ -323,6 +323,35 @@ class WorkerConfig:
     gateway_max_conn: int = field(
         default_factory=lambda: int(_env("GATEWAY_MAX_CONN", "256"))
     )
+    # -- multi-tenant QoS (serve/qos.py, gateway auth + batcher fair share) ---
+    # API-key table: comma-separated ``key:tenant:class[:weight[:rps
+    # [:monthly_tokens]]]`` entries (class in batch|standard|premium; rps is
+    # a per-key token-bucket rate, monthly_tokens a per-tenant completion
+    # quota; 0/omitted = unlimited). Empty (the default) disables auth: the
+    # gateway serves everyone as the anonymous standard tenant, exactly the
+    # pre-QoS behavior.
+    api_keys: str = field(default_factory=lambda: _env("API_KEYS", ""))
+    # tenant-label cardinality cap for every Prometheus exposition (worker,
+    # gateway, aggregator): the top-K tenants by volume keep their own rows,
+    # the rest roll up into tenant="other" — a key-guessing client cannot
+    # mint unbounded label values. 0 disables the cap.
+    qos_tenant_topk: int = field(
+        default_factory=lambda: int(_env("QOS_TENANT_TOPK", "8"))
+    )
+    # premium preempt-to-host-tier: a premium admit that finds the KV pool
+    # full suspends the lowest-class victim slot to host RAM (resumed
+    # bit-identically when pressure clears) before ever shedding. Off
+    # restores class-blind victim selection (largest slot first).
+    qos_preempt: bool = field(
+        default_factory=lambda: _env("QOS_PREEMPT", "1").strip().lower()
+        not in ("0", "false", "off")
+    )
+    # deficit-round-robin quantum in prompt tokens per round per unit of
+    # class weight: smaller = tighter interleaving (fairness converges
+    # faster), larger = longer per-tenant runs (better admit batching)
+    qos_quantum_tokens: int = field(
+        default_factory=lambda: int(_env("QOS_QUANTUM_TOKENS", "256"))
+    )
     # -- cluster observability plane (obs/aggregator.py + obs/trace.py) -------
     # kill switch for cross-process span emission: when off, gateway/router/
     # worker skip publishing span batches to {prefix}.obs.spans entirely

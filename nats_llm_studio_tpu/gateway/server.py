@@ -48,10 +48,19 @@ from ..obs import (
     span_context_value,
 )
 from ..serve.constrain import validate_response_format
+from ..serve.qos import (
+    ANON_TENANT,
+    ApiKeySpec,
+    TenantUsage,
+    TokenBucket,
+    cap_tenant_rows,
+    format_priority_header,
+    parse_api_keys,
+)
 from ..serve.router import ClusterRouter, RouterExhausted
 from ..transport import ConnectionClosedError, NatsClient, RetryPolicy
 from ..transport import protocol as p
-from ..transport.envelope import error_is_retryable
+from ..transport.envelope import error_is_retryable, shed_cause_of
 
 log = logging.getLogger(__name__)
 
@@ -61,11 +70,13 @@ MAX_BODY_BYTES = 10 * 1024 * 1024
 _REASONS = {
     200: "OK",
     400: "Bad Request",
+    401: "Unauthorized",
     404: "Not Found",
     405: "Method Not Allowed",
     408: "Request Timeout",
     411: "Length Required",
     413: "Payload Too Large",
+    429: "Too Many Requests",
     500: "Internal Server Error",
     503: "Service Unavailable",
     504: "Gateway Timeout",
@@ -156,9 +167,30 @@ def _status_for_error(err: str) -> tuple[int, str, str | None]:
         return 400, "invalid_request_error", None
     if "deadline exceeded" in low:
         return 504, "timeout_error", "deadline_exceeded"
+    # cause-aware sheds (transport/envelope.py SHED_CAUSES): quota and
+    # fair_share are the CALLER's budget — 429, because retrying the same
+    # request elsewhere cannot help; the remaining causes are worker-local
+    # pressure and fall through to the generic retryable 503 below
+    cause = shed_cause_of(err)
+    if cause in ("quota", "fair_share"):
+        return 429, "rate_limit_error", cause
     if error_is_retryable(err):
         return 503, "overloaded_error", "worker_unavailable"
     return 500, "api_error", None
+
+
+def _envelope_error_response(err: str) -> tuple[int, dict, dict | None]:
+    """(status, OpenAI error body, extra headers) for a worker error
+    envelope — the body carries the machine-readable shed cause when the
+    error text embeds one, so clients can branch on quota-vs-pressure
+    without parsing prose."""
+    status, etype, code = _status_for_error(err)
+    body = _error_body(err, etype, code)
+    cause = shed_cause_of(err)
+    if cause:
+        body["error"]["cause"] = cause
+    extra = {"Retry-After": "1"} if status in (429, 503) else None
+    return status, body, extra
 
 
 class Gateway:
@@ -183,6 +215,8 @@ class Gateway:
         prefix_head_chars: int = 256,
         obs_spans: bool | None = None,
         ident: str = "gateway",
+        api_keys: str = "",
+        tenant_topk: int = 8,
     ):
         self.nc = nc
         self.prefix = prefix
@@ -227,6 +261,20 @@ class Gateway:
         # for streams) — the edge-side counterpart of the workers'
         # lmstudio_ttft_ms, including routing, retries, and queueing
         self._ttft_ms = LogHistogram()
+        # multi-tenant QoS front door (serve/qos.py): the API_KEYS table
+        # maps bearer keys to (tenant, priority class, weight, rate,
+        # monthly quota). Empty = auth off, everyone is the anonymous
+        # standard tenant — exactly the pre-QoS behavior. parse_api_keys
+        # raises on a malformed spec: fail at boot, not at first request.
+        self.api_keys = parse_api_keys(api_keys)
+        self.tenant_topk = int(tenant_topk)
+        self._buckets: dict[str, TokenBucket] = {
+            k: TokenBucket(s.rps) for k, s in self.api_keys.items() if s.rps > 0
+        }
+        self._usage = TenantUsage()
+        self._tenant_requests: dict[str, int] = {}
+        # 401/429 refusals by tenant ("unknown" for bad/missing keys)
+        self._tenant_rejected: dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -372,6 +420,12 @@ class Gateway:
             await self._respond_text(writer, 200, self.render_prometheus())
             return
         if method == "GET" and path == "/v1/models":
+            # key validity is enforced (the model list is tenant surface),
+            # but listing consumes no rate-bucket tokens or quota
+            _, auth_err = self._resolve_key(headers)
+            if auth_err is not None:
+                await self._respond(writer, auth_err[0], auth_err[1])
+                return
             await self._get_models(writer)
             return
         if path == "/v1/chat/completions":
@@ -382,10 +436,20 @@ class Gateway:
                     extra={"Allow": "POST"},
                 )
                 return
+            spec, auth_err = self._resolve_key(headers)
+            if auth_err is not None:
+                await self._respond(writer, auth_err[0], auth_err[1])
+                return
+            admit_err = self._admit(spec)
+            if admit_err is not None:
+                await self._respond(
+                    writer, admit_err[0], admit_err[1], extra=admit_err[2]
+                )
+                return
             body = await self._read_body(reader, writer, headers)
             if body is None:
                 return
-            await self._chat(reader, writer, headers, body)
+            await self._chat(reader, writer, headers, body, spec)
             return
         await self._respond(
             writer, 404,
@@ -493,7 +557,111 @@ class Gateway:
                   help="extra routed attempts behind served chat replies")
         r.histogram("lmstudio_gateway_ttft_ms", self._ttft_ms.snapshot(),
                     help="request-line read to first response byte, ms")
+        # per-tenant edge families under the same top-K + "other" cardinality
+        # cap as the workers' lmstudio_tenant_* families (serve/qos.py)
+        for tenant, v in sorted(cap_tenant_rows(
+            self._tenant_requests, self.tenant_topk
+        ).items()):
+            r.counter("lmstudio_gateway_tenant_requests_total", v,
+                      labels={"tenant": tenant},
+                      help="chat requests accepted past auth, by tenant")
+        for tenant, v in sorted(cap_tenant_rows(
+            self._tenant_rejected, self.tenant_topk
+        ).items()):
+            r.counter("lmstudio_gateway_tenant_rejected_total", v,
+                      labels={"tenant": tenant},
+                      help="401/429 refusals (bad key, rate limit, monthly "
+                           "quota), by tenant; 'unknown' = unauthenticated")
+        usage_rows = {
+            t: row["tokens"] for t, row in self._usage.snapshot().items()
+        }
+        for tenant, v in sorted(cap_tenant_rows(
+            usage_rows, self.tenant_topk
+        ).items()):
+            r.counter("lmstudio_gateway_tenant_tokens_total", v,
+                      labels={"tenant": tenant},
+                      help="completion tokens charged this month, by tenant")
         return r.render()
+
+    # -- multi-tenant QoS front door -----------------------------------------
+
+    def _resolve_key(
+        self, http_headers: dict[str, str]
+    ) -> tuple[ApiKeySpec | None, tuple[int, dict] | None]:
+        """Authenticate the request: (key spec, None) on success, (None,
+        (status, body)) on refusal. With no API_KEYS configured every
+        caller passes as the anonymous standard tenant (spec None)."""
+        if not self.api_keys:
+            return None, None
+        auth = http_headers.get("authorization", "")
+        scheme, _, key = auth.partition(" ")
+        key = key.strip()
+        if not auth or scheme.lower() != "bearer" or not key:
+            self._tenant_rejected["unknown"] = (
+                self._tenant_rejected.get("unknown", 0) + 1
+            )
+            return None, (401, _error_body(
+                "missing API key: pass 'Authorization: Bearer <key>'",
+                "authentication_error", "invalid_api_key",
+            ))
+        spec = self.api_keys.get(key)
+        if spec is None:
+            self._tenant_rejected["unknown"] = (
+                self._tenant_rejected.get("unknown", 0) + 1
+            )
+            return None, (401, _error_body(
+                "invalid API key", "authentication_error", "invalid_api_key",
+            ))
+        return spec, None
+
+    def _admit(
+        self, spec: ApiKeySpec | None
+    ) -> tuple[int, dict, dict[str, str]] | None:
+        """Rate-limit + monthly-quota gate for an authenticated chat:
+        None = admitted, else (status, body, extra headers) for the 429."""
+        if spec is None:
+            return None
+        bucket = self._buckets.get(spec.key)
+        if bucket is not None and not bucket.take():
+            self._tenant_rejected[spec.tenant] = (
+                self._tenant_rejected.get(spec.tenant, 0) + 1
+            )
+            retry_after = max(1, int(bucket.retry_after_s() + 0.999))
+            body = _error_body(
+                f"rate limit exceeded for tenant {spec.tenant}: "
+                f"{spec.rps:g} requests/s (shed_cause=quota)",
+                "rate_limit_error", "rate_limit_exceeded",
+            )
+            body["error"]["cause"] = "quota"
+            return 429, body, {"Retry-After": str(retry_after)}
+        if spec.monthly_tokens > 0 and self._usage.over_quota(
+            spec.tenant, spec.monthly_tokens
+        ):
+            self._tenant_rejected[spec.tenant] = (
+                self._tenant_rejected.get(spec.tenant, 0) + 1
+            )
+            body = _error_body(
+                f"monthly token quota exhausted for tenant {spec.tenant}: "
+                f"{self._usage.tokens_used(spec.tenant)} of "
+                f"{spec.monthly_tokens} tokens used (shed_cause=quota)",
+                "rate_limit_error", "insufficient_quota",
+            )
+            body["error"]["cause"] = "quota"
+            # a monthly quota resets at the month boundary, not in seconds;
+            # 3600 keeps well-behaved clients from hammering the refusal
+            return 429, body, {"Retry-After": "3600"}
+        return None
+
+    def _charge_usage(self, spec: ApiKeySpec | None, response: dict) -> None:
+        """Book a served chat's completion tokens against the tenant's
+        month (anonymous traffic is tracked too — it shows in /metrics)."""
+        usage = response.get("usage") or {}
+        tokens = usage.get("completion_tokens") or 0
+        tenant = spec.tenant if spec is not None else ANON_TENANT
+        try:
+            self._usage.charge(tenant, int(tokens))
+        except (TypeError, ValueError):
+            self._usage.charge(tenant, 0)
 
     # -- routes --------------------------------------------------------------
 
@@ -520,15 +688,25 @@ class Gateway:
         listing = (env.get("data") or {}).get("models") or {"object": "list", "data": []}
         await self._respond(writer, 200, listing)
 
-    def _bus_headers(self, http_headers: dict[str, str]) -> dict[str, str]:
+    def _bus_headers(
+        self, http_headers: dict[str, str], spec: ApiKeySpec | None = None
+    ) -> dict[str, str]:
         """NATS headers for this HTTP request: trace id and deadline budget
-        pass through from the client when stamped, minted otherwise."""
+        pass through from the client when stamped, minted otherwise. An
+        authenticated key stamps the resolved tenant + priority class (with
+        its fair-share weight override) — NEVER the client's own claim, so
+        an HTTP caller cannot spoof premium through the gateway."""
         out = {p.TRACE_HEADER: http_headers.get(
             p.TRACE_HEADER.lower(), new_trace_id()
         )}
         deadline = http_headers.get(p.DEADLINE_HEADER.lower())
         if deadline:
             out[p.DEADLINE_HEADER] = deadline
+        if spec is not None:
+            out[p.TENANT_HEADER] = spec.tenant
+            out[p.PRIORITY_HEADER] = format_priority_header(
+                spec.priority, spec.weight
+            )
         return out
 
     async def _chat(
@@ -537,6 +715,7 @@ class Gateway:
         writer: asyncio.StreamWriter,
         http_headers: dict[str, str],
         raw_body: bytes,
+        spec: ApiKeySpec | None = None,
     ) -> None:
         try:
             body = json.loads(raw_body or b"null")
@@ -553,8 +732,10 @@ class Gateway:
                 writer, 400, _error_body(str(e), "invalid_request_error")
             )
             return
+        tenant = spec.tenant if spec is not None else ANON_TENANT
+        self._tenant_requests[tenant] = self._tenant_requests.get(tenant, 0) + 1
         payload["stream"] = stream
-        bus_headers = self._bus_headers(http_headers)
+        bus_headers = self._bus_headers(http_headers, spec)
         # the gateway span is the root of the cross-process trace: its id
         # rides the Traceparent header so every router attempt (and, through
         # it, every worker hop) parents under this request
@@ -569,10 +750,12 @@ class Gateway:
         try:
             if stream:
                 status = await self._chat_stream(
-                    reader, writer, payload, bus_headers, t0
+                    reader, writer, payload, bus_headers, t0, spec
                 )
             else:
-                status = await self._chat_once(writer, payload, bus_headers, t0)
+                status = await self._chat_once(
+                    writer, payload, bus_headers, t0, spec
+                )
         finally:
             self._emit_span(Span(
                 trace_id=trace_id, span_id=root_span_id,
@@ -613,6 +796,7 @@ class Gateway:
         payload: dict,
         bus_headers: dict[str, str],
         t0: float,
+        spec: ApiKeySpec | None = None,
     ) -> int:
         try:
             msg = await self.router.request_chat(
@@ -637,16 +821,15 @@ class Gateway:
                 writer, 500, _error_body("worker reply was not JSON", "api_error")
             )
         if not env.get("ok"):
-            status, etype, code = _status_for_error(str(env.get("error", "")))
-            extra = {"Retry-After": "1"} if status == 503 else None
-            return await self._respond(
-                writer, status,
-                _error_body(str(env.get("error")), etype, code), extra=extra,
+            status, body, extra = _envelope_error_response(
+                str(env.get("error", ""))
             )
+            return await self._respond(writer, status, body, extra=extra)
         response = (env.get("data") or {}).get("response") or {}
         response.setdefault("id", f"chatcmpl-{bus_headers[p.TRACE_HEADER]}")
         response.setdefault("created", int(time.time()))
         self._count_retry_hops(response)
+        self._charge_usage(spec, response)
         self._ttft_ms.record((time.monotonic() - t0) * 1000.0)
         return await self._respond(writer, 200, response)
 
@@ -671,6 +854,7 @@ class Gateway:
         payload: dict,
         bus_headers: dict[str, str],
         t0: float,
+        spec: ApiKeySpec | None = None,
     ) -> int:
         self.streams_total += 1
         chat_id = f"chatcmpl-{bus_headers[p.TRACE_HEADER]}"
@@ -727,19 +911,19 @@ class Gateway:
                     if not env.get("ok"):
                         err = str(env.get("error", "stream failed"))
                         if not preamble_sent:
-                            status, etype, code = _status_for_error(err)
-                            extra = {"Retry-After": "1"} if status == 503 else None
+                            status, body, extra = _envelope_error_response(err)
                             return await self._respond(
-                                writer, status, _error_body(err, etype, code),
-                                extra=extra,
+                                writer, status, body, extra=extra,
                             )
                         # headers are gone: surface the error in-band, the
-                        # way api.openai.com does mid-stream
+                        # way api.openai.com does mid-stream (the cause
+                        # token, if any, rides inside the message text)
                         await self._sse(writer, {"error": _error_body(
                             err, *_status_for_error(err)[1:])["error"]})
                         break
                     response = (env.get("data") or {}).get("response") or {}
                     self._count_retry_hops(response)
+                    self._charge_usage(spec, response)
                     if not preamble_sent:
                         await self._sse_start(writer, t0)
                         preamble_sent = True

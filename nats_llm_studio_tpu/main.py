@@ -108,6 +108,8 @@ async def _run_serve(args: argparse.Namespace) -> None:
         obs_recorder_interval_ms=cfg.obs_recorder_interval_ms,
         obs_dump_dir=cfg.obs_dump_dir,
         worker_id=cfg.worker_id,
+        qos_quantum_tokens=cfg.qos_quantum_tokens,
+        qos_preempt=cfg.qos_preempt,
     )
     worker = Worker(cfg, registry)
     await worker.start()
@@ -316,6 +318,8 @@ async def _run_gateway(args: argparse.Namespace) -> None:
         retry=RetryPolicy(max_attempts=args.max_attempts, retry_on_timeout=True),
         stale_after_s=cfg.router_stale_after_s,
         prefix_head_chars=cfg.router_prefix_head_chars,
+        api_keys=cfg.api_keys,
+        tenant_topk=cfg.qos_tenant_topk,
     )
     await gw.start()
     log.info("gateway on http://%s:%d (bus %s, prefix %s)",

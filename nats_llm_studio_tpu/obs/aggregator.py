@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import re
 import time
 from collections import OrderedDict, deque
@@ -111,9 +112,51 @@ MEAN_GAUGE_FAMILIES = frozenset({
     "lmstudio_goodput_tokens_per_device_s",
 })
 
+_TENANT_LABEL = "tenant"
+
+
+def _tenant_topk_env() -> int:
+    try:
+        return int(os.environ.get("QOS_TENANT_TOPK", "8") or 0)
+    except ValueError:
+        return 8
+
+
+def _cap_tenant_series(
+    series: dict[tuple, float], top_k: int
+) -> dict[tuple, float]:
+    """Cardinality cap for a merged scalar family carrying a ``tenant``
+    label: keep the top-K tenants by summed value, fold the rest into
+    ``tenant="other"`` — the cluster-view counterpart of
+    ``serve.qos.cap_tenant_rows`` (N workers' disjoint per-worker top-Ks
+    can union far past K, so the cap must be re-applied after the merge).
+    Series missing the label anywhere pass through untouched."""
+    totals: dict[str, float] = {}
+    for k, v in series.items():
+        t = dict(k).get(_TENANT_LABEL)
+        if t is None:
+            return series
+        totals[t] = totals.get(t, 0.0) + v
+    if top_k <= 0 or len(totals) <= top_k:
+        return series
+    keep = {
+        t for t, _ in sorted(
+            totals.items(), key=lambda kv: (-kv[1], kv[0])
+        )[:top_k] if t != "other"
+    }
+    out: dict[tuple, float] = {}
+    for k, v in series.items():
+        lbl = dict(k)
+        if lbl[_TENANT_LABEL] not in keep:
+            lbl[_TENANT_LABEL] = "other"
+        nk = tuple(sorted(lbl.items()))
+        out[nk] = out.get(nk, 0.0) + v
+    return out
+
 
 def merge_into(renderer: PromRenderer, texts: list[str],
-               drop_labels: tuple[str, ...] = ("worker_id",)) -> None:
+               drop_labels: tuple[str, ...] = ("worker_id",),
+               tenant_topk: int | None = None) -> None:
     """Merge N workers' expositions into ``renderer`` as one cluster view.
 
     Counters and gauges sum across workers by their remaining label sets
@@ -191,8 +234,17 @@ def merge_into(renderer: PromRenderer, texts: list[str],
         else:
             add = renderer.counter if typ == "counter" else renderer.gauge
             mean = typ == "gauge" and family in MEAN_GAUGE_FAMILIES
-            for k in sorted(scalars.get(family, {})):
-                v = scalars[family][k]
+            series = scalars.get(family, {})
+            if not mean and series and any(
+                _TENANT_LABEL in dict(k) for k in series
+            ):
+                series = _cap_tenant_series(
+                    series,
+                    tenant_topk if tenant_topk is not None
+                    else _tenant_topk_env(),
+                )
+            for k in sorted(series):
+                v = series[k]
                 if mean:
                     v /= max(scalar_n.get(family, {}).get(k, 1), 1)
                 add(family, v, labels=dict(k))

@@ -66,6 +66,14 @@ from ..ops.kvcache import (
 from .block_pool import BlockPool
 from .brownout import LEVEL_NAMES, SHED_ONLY, BrownoutConfig, BrownoutController
 from .prefix_cache import PrefixCache
+from .qos import (
+    ANON_TENANT,
+    DEFAULT_PRIORITY,
+    DrrScheduler,
+    TenantStats,
+    class_rank,
+    class_weight,
+)
 from .spec import SpecConfig, SpecSlot, make_slot
 
 log = logging.getLogger(__name__)
@@ -241,6 +249,24 @@ class _Request:
     # (resume re-seeds the device carry token from the tail, and suspend
     # refuses a slot whose history length disagrees with its position)
     emitted: list = field(default_factory=list)
+    # -- multi-tenant QoS (serve/qos.py) ----------------------------------
+    # identity resolved by the gateway's API-key auth and carried on the
+    # X-Tenant/X-Priority bus headers; raw-NATS callers default to the
+    # anonymous standard tenant, so pre-QoS traffic schedules exactly as
+    # before. ``weight`` overrides the class weight in DRR when the key
+    # spec sets one (0 = derive from class).
+    tenant: str = ANON_TENANT
+    priority: str = DEFAULT_PRIORITY
+    weight: float = 0.0
+
+    @property
+    def rank(self) -> int:
+        """0 = batch (shed/preempt first) .. 2 = premium (shed last)."""
+        return class_rank(self.priority)
+
+    @property
+    def drr_weight(self) -> float:
+        return self.weight if self.weight > 0 else float(class_weight(self.priority))
 
     @property
     def is_ext(self) -> bool:
@@ -540,6 +566,8 @@ class ContinuousBatcher:
         recorder=None,
         kv_tiers=None,
         kv_suspend: bool | None = None,
+        qos_quantum_tokens: int = 256,
+        qos_preempt: bool | None = None,
     ):
         from ..models.llama import ensure_lm_head
 
@@ -764,6 +792,23 @@ class ContinuousBatcher:
             "suspend_failures": 0,
             "suspended_deadline_expired": 0,
         }
+        # multi-tenant QoS (serve/qos.py): admission is deficit round-robin
+        # over per-tenant queues weighted by priority class — the owner loop
+        # re-orders the waitlist through the scheduler before each admission
+        # pass (single-tenant traffic degenerates to exact FIFO), brownout
+        # sheds strictly batch < standard < premium at _enqueue, and with
+        # ``qos_preempt`` a higher-class admit that finds the pool full
+        # parks the lowest strictly-lower-class victim via the suspend path
+        # (resumed bit-identically when pressure clears) before ever
+        # shedding. QOS_PREEMPT=0 restores class-blind victim selection;
+        # preemption rides the suspend machinery, so it needs paged KV.
+        if qos_preempt is None:
+            qos_preempt = os.environ.get("QOS_PREEMPT", "1").strip().lower() not in (
+                "0", "false", "off"
+            )
+        self.qos_preempt = bool(qos_preempt) and self.kv_suspend
+        self._drr = DrrScheduler(quantum=max(1, int(qos_quantum_tokens)))
+        self.tenant_stats = TenantStats()
         # owner-maintained snapshot of the live slots for debug_snapshot()
         # (the real tables/host_pos are _run locals): slot -> {pos,
         # generated, blocks, ...}. Replaced wholesale each loop iteration
@@ -1653,6 +1698,16 @@ class ContinuousBatcher:
         else:
             st.attribute_device_time(category, pre + dec)
 
+    def _tenant_served(self, req) -> None:
+        """Per-tenant completion accounting for the QoS metrics plane:
+        generated tokens (the billable unit) and queue age (admit wait —
+        the fairness signal a starved tenant shows first)."""
+        try:
+            age_ms = max(0.0, (req.t_admit - req.t_enq) * 1e3)
+            self.tenant_stats.record_served(req.tenant, req.generated, age_ms)
+        except Exception:  # noqa: BLE001 — metrics must never kill the pump
+            pass
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
@@ -2037,6 +2092,9 @@ class ContinuousBatcher:
         want_logprobs: bool = False,
         top_logprobs: int = 0,
         waste_tag: str | None = None,
+        tenant: str = ANON_TENANT,
+        priority: str = DEFAULT_PRIORITY,
+        weight: float = 0.0,
     ) -> _Request:
         if not prompt_ids:
             raise ValueError("empty prompt")
@@ -2066,15 +2124,21 @@ class ContinuousBatcher:
             want_logprobs=want_logprobs or top_logprobs > 0,
             top_logprobs=int(top_logprobs),
             waste_tag=waste_tag,
+            tenant=str(tenant or ANON_TENANT),
+            priority=priority,
+            weight=max(0.0, float(weight)),
         )
+        self.tenant_stats.record_request(req.tenant)
         if trace is not None:
             trace.mark("enqueue", req.t_enq)
         # expired before it was even queued: shed at submit, zero device work
         # (the caller's budget is gone — serving it helps nobody)
         if deadline is not None and req.t_enq >= deadline:
             self.stats.record_shed("deadline")
+            self.tenant_stats.record_shed(req.tenant)
             raise BatcherOverloaded(
-                "deadline already expired at submit; retry on another worker"
+                "deadline already expired at submit (shed_cause=deadline); "
+                "retry on another worker"
             )
         bo = self.brownout
         with self._submit_lock:
@@ -2098,24 +2162,45 @@ class ContinuousBatcher:
                     age_p95_ms=0.0,
                     hbm_headroom_frac=headroom,
                 )
-            if bo is not None and bo.level >= SHED_ONLY:
-                # shed-only brownout: queued work drains, new work bounces
-                # immediately with a retryable envelope
+            if bo is not None and bo.level > req.rank:
+                # priority-ordered brownout: the load-shed level IS the
+                # lowest class still admitted — BROWNOUT (1) sheds batch
+                # (rank 0), SHED_ONLY (2) sheds batch AND standard, and
+                # premium (rank 2) is never brownout-shed (only the depth
+                # bound below can refuse it). Rank-1 behavior at SHED_ONLY
+                # is exactly the pre-QoS bounce every default-class caller
+                # already saw.
                 self.stats.record_shed("brownout")
-                raise BatcherOverloaded(
-                    "brownout shed-only: worker saturated; retry on another worker"
-                )
+                self.tenant_stats.record_shed(req.tenant)
+                if bo.level >= SHED_ONLY:
+                    msg = (
+                        "brownout shed-only: worker saturated "
+                        "(shed_cause=brownout); retry on another worker"
+                    )
+                else:
+                    msg = (
+                        f"brownout: {req.priority} class shed first "
+                        f"(shed_cause=brownout); retry on another worker"
+                    )
+                raise BatcherOverloaded(msg)
             limit = (
                 bo.effective_queue_limit(self.max_queue)
                 if bo is not None
                 else self.max_queue
             )
-            if limit and self._inbox.qsize() + self._wl_len >= limit:
-                self.stats.record_shed("depth")
-                raise BatcherOverloaded(
-                    f"admit queue full ({limit} waiting); retry on "
-                    f"another worker"
-                )
+            if limit:
+                # premium rides a 50% depth grace past the bound: a queue
+                # full of lower classes must not bounce it — the owner loop
+                # displaces the lowest-fair-share waiters instead (the
+                # shed_cause=fair_share path)
+                eff = limit + (limit >> 1) + 1 if req.rank >= 2 else limit
+                if self._inbox.qsize() + self._wl_len >= eff:
+                    self.stats.record_shed("depth")
+                    self.tenant_stats.record_shed(req.tenant)
+                    raise BatcherOverloaded(
+                        f"admit queue full ({limit} waiting) "
+                        f"(shed_cause=depth); retry on another worker"
+                    )
             self._inbox.put(req)
         return req
 
@@ -2137,6 +2222,9 @@ class ContinuousBatcher:
         want_logprobs: bool = False,
         top_logprobs: int = 0,
         waste_tag: str | None = None,
+        tenant: str = ANON_TENANT,
+        priority: str = DEFAULT_PRIORITY,
+        weight: float = 0.0,
     ) -> AsyncIterator[int]:
         """Yield generated token ids for one request.
 
@@ -2150,6 +2238,7 @@ class ContinuousBatcher:
             prompt_ids, sp, info=info, trace=trace, deadline=deadline,
             constrain=constrain, want_logprobs=want_logprobs,
             top_logprobs=top_logprobs, waste_tag=waste_tag,
+            tenant=tenant, priority=priority, weight=weight,
         ):
             for tok in batch:
                 yield tok
@@ -2165,6 +2254,9 @@ class ContinuousBatcher:
         want_logprobs: bool = False,
         top_logprobs: int = 0,
         waste_tag: str | None = None,
+        tenant: str = ANON_TENANT,
+        priority: str = DEFAULT_PRIORITY,
+        weight: float = 0.0,
     ) -> AsyncIterator[list]:
         """Like ``submit`` but yields LISTS of tokens: everything already
         delivered when the consumer wakes comes out as one batch. A decode
@@ -2186,6 +2278,7 @@ class ContinuousBatcher:
             prompt_ids, sp, trace=trace, deadline=deadline,
             constrain=constrain, want_logprobs=want_logprobs,
             top_logprobs=top_logprobs, waste_tag=waste_tag,
+            tenant=tenant, priority=priority, weight=weight,
         )
         done = False
         try:
@@ -2413,7 +2506,8 @@ class ContinuousBatcher:
         suspend_on = self.kv_suspend and paged
 
         def alloc_blocks(k: int, suspend_ok: bool = True,
-                         internal: bool = False) -> list[int]:
+                         internal: bool = False,
+                         for_req: _Request | None = None) -> list[int]:
             """Take k fresh pool blocks; on shortage, reclaim unpinned
             prefix-cache blocks (the evictable tier — demoted to the host
             tier when one is attached, discarded otherwise), then suspend
@@ -2427,12 +2521,34 @@ class ContinuousBatcher:
             dispatch. ``internal=True`` marks opportunistic allocations
             (tier promotion, slot resume) — they must neither suspend
             another slot (thrash cycles) nor count a shed (the caller just
-            defers the work), so exhaustion raises a quiet _PoolExhausted."""
+            defers the work), so exhaustion raises a quiet _PoolExhausted.
+
+            ``for_req`` is the ADMITTING request (QoS preemption): a
+            higher-class admit that finds the pool full first preempts
+            strictly-lower-class victims (lowest class, largest table
+            first) to the host tier — reason "preempted", resumed
+            bit-identically when pressure clears — before falling back to
+            the class-blind swap-don't-shed sweep."""
             got = pool.alloc(k)
             if got is None and pc is not None:
                 pc.reclaim(k - pool.free_blocks, demote=tier is not None)
                 got = pool.alloc(k)
             if got is None and suspend_on and suspend_ok and not internal:
+                if (
+                    self.qos_preempt
+                    and for_req is not None
+                    and for_req.rank > 0
+                ):
+                    # preempt-to-host-tier: only strictly-lower classes are
+                    # eligible, so a premium admit never parks a premium peer
+                    while got is None and suspend_victim(
+                        below_rank=for_req.rank, reason="preempted"
+                    ):
+                        if pc is not None and pool.free_blocks < k:
+                            pc.reclaim(
+                                k - pool.free_blocks, demote=tier is not None
+                            )
+                        got = pool.alloc(k)
                 # swap-don't-shed: demote whole victim slots (blocks + full
                 # resume state) to the host tier until the allocation fits
                 while got is None and suspend_victim():
@@ -2452,6 +2568,8 @@ class ContinuousBatcher:
                     # a shed here: grow_for_burst may park the slot instead
                     # of shedding it, and records the shed itself when not
                     self.stats.record_shed("kv_pool")
+                    if for_req is not None:
+                        self.tenant_stats.record_shed(for_req.tenant)
                 if self.recorder is not None:
                     # rate-limited (not forced): a starved pool sheds every
                     # admit attempt, one dump per window tells the story
@@ -2462,7 +2580,8 @@ class ContinuousBatcher:
                     )
                 raise _PoolExhausted(
                     f"kv block pool exhausted ({k} blocks needed, "
-                    f"{pool.free_blocks} free); retry on another worker"
+                    f"{pool.free_blocks} free) (shed_cause=kv_pool); "
+                    f"retry on another worker"
                 )
             return got
 
@@ -2692,6 +2811,7 @@ class ContinuousBatcher:
                             reason = self._deliver(req, t)
                             if reason is not None:
                                 self._ledger_finalize(req, "served")
+                                self._tenant_served(req)
                                 finish_slot(slot)  # free BEFORE the end event
                                 req.emit("end", reason)
                                 break
@@ -2755,6 +2875,7 @@ class ContinuousBatcher:
                             reason = self._deliver(req, t)
                             if reason is not None:
                                 self._ledger_finalize(req, "served")
+                                self._tenant_served(req)
                                 finish_slot(slot)  # free BEFORE the end event
                                 req.emit("end", reason)
                                 break
@@ -2812,6 +2933,7 @@ class ContinuousBatcher:
                             reason = "stop"
                         if reason is not None:
                             self._ledger_finalize(req, "served")
+                            self._tenant_served(req)
                             finish_slot(slot)  # free BEFORE the end event
                             req.emit("end", reason)
                     except Exception:  # noqa: BLE001 — dead client
@@ -2857,6 +2979,7 @@ class ContinuousBatcher:
                         reason = self._deliver(req, first)
                         if reason is not None:
                             self._ledger_finalize(req, "served")
+                            self._tenant_served(req)
                             finish_slot(slot)  # free BEFORE the end event
                             req.emit("end", reason)
                         elif spec is not None:
@@ -3336,23 +3459,33 @@ class ContinuousBatcher:
             finish_slot(i)  # decrefs the blocks; the host copy owns the KV
             self._suspended.append(srec)
             self._suspend_stats["suspended_total"] += 1
+            if reason == "preempted":
+                # the victim is parked, not lost — this counts preemption
+                # events per tenant (noisy-neighbor diagnosis), not sheds
+                self.tenant_stats.record_preempted(req.tenant)
             obs_emit(
                 "slot_suspend", slot=i, reason=reason, pos=srec.pos,
                 generated=req.generated, blocks=srec.n_blocks,
             )
             return True
 
-        def suspend_victim() -> bool:
-            """Suspend the slot whose demotion frees the most pool blocks
-            (falling through candidates a drain disqualifies). False when
-            nothing is suspendable."""
+        def suspend_victim(below_rank: int | None = None,
+                           reason: str = "kv_pool") -> bool:
+            """Suspend the victim slot whose demotion frees the most pool
+            blocks (falling through candidates a drain disqualifies),
+            lowest priority class first — under uniform class this is
+            exactly the pre-QoS largest-table-first sweep. ``below_rank``
+            restricts candidates to strictly-lower classes (preemption on
+            behalf of a higher-class admit). False when nothing is
+            suspendable."""
             cand = sorted(
                 (i for i, r in enumerate(self._slots)
-                 if isinstance(r, _Request) and not r.cancelled and tables[i]),
-                key=lambda i: len(tables[i]), reverse=True,
+                 if isinstance(r, _Request) and not r.cancelled and tables[i]
+                 and (below_rank is None or r.rank < below_rank)),
+                key=lambda i: (self._slots[i].rank, -len(tables[i])),
             )
             for i in cand:
-                if suspend_slot(i, "kv_pool"):
+                if suspend_slot(i, reason):
                     return True
             return False
 
@@ -3696,7 +3829,7 @@ class ContinuousBatcher:
             C = self.prefill_chunk
             if n <= C:
                 bucket = self._bucket(n)
-                ids = alloc_blocks(-(-n // T))
+                ids = alloc_blocks(-(-n // T), for_req=req)
                 tables[slot] = ids
                 table_dirty = True
                 bids = ids + [0] * (max(1, bucket // T) - len(ids))
@@ -3824,7 +3957,7 @@ class ContinuousBatcher:
             # program-ordered before any later admit's gather of these ids)
             total = -(-n // T)
             bstart = len(tables[slot])
-            tables[slot].extend(alloc_blocks(total - bstart))
+            tables[slot].extend(alloc_blocks(total - bstart, for_req=req))
             table_dirty = True
             harvest_prefix(
                 req.prompt_ids, None, None, 0, chunk_logits,
@@ -4092,7 +4225,9 @@ class ContinuousBatcher:
                 if paged:
                     nblk_row = max(1, bucket // T)
                     for j, s in enumerate(slots):
-                        tables[s] = alloc_blocks(-(-ns[j] // T))
+                        tables[s] = alloc_blocks(
+                            -(-ns[j] // T), for_req=reqs[j]
+                        )
                     table_dirty = True
                     bid_rows = [
                         tables[slots[i]]
@@ -4258,7 +4393,9 @@ class ContinuousBatcher:
                     # tables BEFORE harvest (the paged harvest records the
                     # rows' pool block ids, not device copies)
                     for j, s in enumerate(slots):
-                        tables[s] = alloc_blocks(-(-ns[j] // T))
+                        tables[s] = alloc_blocks(
+                            -(-ns[j] // T), for_req=reqs[j]
+                        )
                     table_dirty = True
                 if glogits is not None:
                     # harvest each real row's full-chunk blocks BEFORE the
@@ -4502,8 +4639,13 @@ class ContinuousBatcher:
                         ]
                         if len(live) <= target:
                             break
-                        victim = max(
-                            live, key=lambda i: self._slots[i].t_admit
+                        # lowest class first, youngest within a class — a
+                        # premium stream is the last to be parked
+                        victim = min(
+                            live,
+                            key=lambda i: (
+                                self._slots[i].rank, -self._slots[i].t_admit
+                            ),
                         )
                         if not suspend_slot(victim, "brownout"):
                             break
@@ -4531,11 +4673,14 @@ class ContinuousBatcher:
                         continue
                     waited_ms = (now - r.t_enq) * 1e3
                     self.stats.record_shed("deadline", waited_ms=waited_ms)
+                    self.tenant_stats.record_shed(r.tenant)
                     msg = (
                         f"deadline infeasible (~{self._estimate_serve_s(r) * 1e3:.0f} ms "
-                        f"needed, {left * 1e3:.0f} ms left); skipped prefill; "
+                        f"needed, {left * 1e3:.0f} ms left) "
+                        f"(shed_cause=deadline); skipped prefill; "
                         if left > 0
-                        else f"deadline expired after {waited_ms:.0f} ms queued; "
+                        else f"deadline expired after {waited_ms:.0f} ms "
+                        f"queued (shed_cause=deadline); "
                     )
                     try:
                         r.emit("err", BatcherOverloaded(
@@ -4560,7 +4705,8 @@ class ContinuousBatcher:
                     try:
                         r.emit("err", BatcherOverloaded(
                             f"deadline exceeded mid-decode after {r.generated} "
-                            f"tokens; retry on another worker"
+                            f"tokens (shed_cause=deadline); retry on another "
+                            f"worker"
                         ))
                     except Exception:  # noqa: BLE001 — dead client loop
                         pass
@@ -4587,11 +4733,12 @@ class ContinuousBatcher:
                         )
                         self._suspend_stats["suspended_deadline_expired"] += 1
                         self._ledger_finalize(r, "deadline_abort")
+                        self.tenant_stats.record_shed(r.tenant)
                         try:
                             r.emit("err", BatcherOverloaded(
                                 f"deadline exceeded while suspended after "
-                                f"{r.generated} tokens; retry on another "
-                                f"worker"
+                                f"{r.generated} tokens (shed_cause=deadline); "
+                                f"retry on another worker"
                             ))
                         except Exception:  # noqa: BLE001 — dead client loop
                             pass
@@ -4601,6 +4748,49 @@ class ContinuousBatcher:
             # resume parked slots BEFORE admitting new waiters: they are
             # strictly older work and already hold their first tokens
             resume_suspended()
+            # weighted fair-share admission: reorder the waitlist by
+            # deficit round-robin over tenants (FIFO within a tenant,
+            # prompt tokens as cost, class/key weight as share). A single
+            # tenant degenerates to exact FIFO, so every pre-QoS workload
+            # admits in the same order it always did.
+            if len(waitlist) > 1:
+                waitlist[:] = self._drr.order(
+                    waitlist,
+                    tenant_of=lambda r: r.tenant,
+                    cost_of=lambda r: len(r.prompt_ids),
+                    weight_of=lambda r: r.drr_weight,
+                )
+                # the premium depth grace in _enqueue can leave the queue
+                # over its bound; settle it here by displacing the excess
+                # from the BACK of the DRR order, lowest class first — the
+                # requests weighted fair share says would wait the longest
+                # anyway go retry on a less loaded worker
+                limit = (
+                    bo.effective_queue_limit(self.max_queue)
+                    if bo is not None else self.max_queue
+                )
+                if limit and len(waitlist) > limit:
+                    order = {id(r): i for i, r in enumerate(waitlist)}
+                    excess = len(waitlist) - limit
+                    victims = sorted(
+                        waitlist, key=lambda r: (r.rank, -order[id(r)])
+                    )[:excess]
+                    vset = {id(r) for r in victims}
+                    waitlist[:] = [r for r in waitlist if id(r) not in vset]
+                    for r in victims:
+                        waited_ms = (now - r.t_enq) * 1e3
+                        self.stats.record_shed(
+                            "fair_share", waited_ms=waited_ms
+                        )
+                        self.tenant_stats.record_shed(r.tenant)
+                        try:
+                            r.emit("err", BatcherOverloaded(
+                                "displaced by weighted fair share "
+                                "(shed_cause=fair_share); retry on another "
+                                "worker"
+                            ))
+                        except Exception:  # noqa: BLE001 — dead client
+                            pass
             self._wl_len = len(waitlist)
             # admit waiters: bursts of short same-bucket prompts go through
             # one batched dispatch; runs of LONG prompts go through one
@@ -4793,11 +4983,12 @@ class ContinuousBatcher:
                     waited_ms = (now - r.t_enq) * 1e3
                     if waited_ms > self.max_queue_age_ms:
                         self.stats.record_shed("age", waited_ms=waited_ms)
+                        self.tenant_stats.record_shed(r.tenant)
                         try:
                             r.emit("err", BatcherOverloaded(
                                 f"shed after {waited_ms:.0f} ms queued "
-                                f"(> {self.max_queue_age_ms:.0f} ms bound); "
-                                f"retry on another worker"
+                                f"(> {self.max_queue_age_ms:.0f} ms bound) "
+                                f"(shed_cause=age); retry on another worker"
                             ))
                         except Exception:  # noqa: BLE001 — dead client loop
                             pass

@@ -37,6 +37,7 @@ from .batcher import (
 )
 from .brownout import BrownoutConfig
 from .constrain import ConstraintError, compile_token_dfa, validate_response_format
+from .qos import ANON_TENANT, DEFAULT_PRIORITY, parse_priority_header
 from .template import render_chat_template, stop_token_ids
 
 log = logging.getLogger(__name__)
@@ -373,7 +374,7 @@ class JaxChatEngine(ChatEngine):
     async def _stream_one(
         self, index: int, prompt_ids: list[int], sp: SamplingParams,
         trace, deadline, dfa, want_lp: bool, top_n: int, result: dict,
-        waste_tag: str | None = None,
+        waste_tag: str | None = None, qos: tuple | None = None,
     ) -> AsyncIterator[dict]:
         """Drive ONE choice through the batcher: yields OpenAI chunk dicts
         tagged with choice ``index`` and fills ``result`` with the
@@ -388,10 +389,12 @@ class JaxChatEngine(ChatEngine):
         # batched iteration: a decode burst's tokens land as ONE chunk
         # message (the delta simply carries more text) — per-message
         # publish overhead is a real share of throughput at 64+ streams
+        tenant, priority, weight = qos or (ANON_TENANT, DEFAULT_PRIORITY, 0.0)
         async for tok_batch in self.batcher.submit_batched(
             prompt_ids, sp, info=end_info, trace=trace, deadline=deadline,
             constrain=dfa, want_logprobs=want_lp, top_logprobs=top_n,
-            waste_tag=waste_tag,
+            waste_tag=waste_tag, tenant=tenant, priority=priority,
+            weight=weight,
         ):
             if not toks:
                 stats.ttft_s = time.perf_counter() - t0
@@ -468,6 +471,13 @@ class JaxChatEngine(ChatEngine):
         # the batcher which charges this request's prefill device-ms to
         # that category instead of "served"
         waste_tag = payload.pop("_waste_tag", None)
+        # tenant identity + priority class injected by the worker from the
+        # gateway-stamped X-Tenant/X-Priority bus headers: popped for the
+        # same stays-verbatim reason; raw-NATS callers that set neither
+        # serve as the anonymous tenant at standard priority (backcompat)
+        tenant = str(payload.pop("_tenant", None) or ANON_TENANT)
+        priority, weight = parse_priority_header(payload.pop("_priority", None))
+        qos = (tenant, priority, weight)
         prompt_ids = self._encode_prompt(payload)
         sp = self._sampling(payload)
         dfa, want_lp, top_n, n_choices = self._parse_ext(payload)
@@ -476,13 +486,13 @@ class JaxChatEngine(ChatEngine):
             if n_choices == 1:
                 async for chunk in self._stream_one(
                     0, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
-                    results[0], waste_tag=waste_tag,
+                    results[0], waste_tag=waste_tag, qos=qos,
                 ):
                     yield chunk
             else:
                 async for chunk in self._stream_n(
                     prompt_ids, sp, trace, deadline, dfa, want_lp, top_n,
-                    results, waste_tag=waste_tag,
+                    results, waste_tag=waste_tag, qos=qos,
                 ):
                     yield chunk
         except BatcherOverloaded as e:
@@ -514,7 +524,7 @@ class JaxChatEngine(ChatEngine):
 
     async def _stream_n(
         self, prompt_ids, sp, trace, deadline, dfa, want_lp, top_n, results,
-        waste_tag: str | None = None,
+        waste_tag: str | None = None, qos: tuple | None = None,
     ) -> AsyncIterator[dict]:
         """n>1 fan-out: each choice is its own batcher request. Choice 0
         launches alone; the rest launch after its first chunk, so choice
@@ -539,7 +549,7 @@ class JaxChatEngine(ChatEngine):
                 async for chunk in self._stream_one(
                     i, prompt_ids, sp_for(i), trace if i == 0 else None,
                     deadline, dfa, want_lp, top_n, results[i],
-                    waste_tag=waste_tag if i == 0 else None,
+                    waste_tag=waste_tag if i == 0 else None, qos=qos,
                 ):
                     await queue.put(chunk)
                     if i == 0:
@@ -675,6 +685,8 @@ class LocalRegistry(Registry):
         pull_precompile: bool | None = None,
         kv_host_pool_bytes: int | None = None,
         kv_spill_factory=None,
+        qos_quantum_tokens: int | None = None,
+        qos_preempt: bool | None = None,
     ):
         self.store = store
         self.mesh = mesh
@@ -750,6 +762,16 @@ class LocalRegistry(Registry):
             if deadline_min_tokens is not None
             else _deadline_min_tokens_env()
         )
+        # multi-tenant QoS (serve/qos.py) handed to every batcher: the DRR
+        # quantum (prompt tokens per fair-share round) and the premium
+        # preempt-to-host-tier toggle. None reads QOS_QUANTUM_TOKENS here;
+        # the batcher itself resolves a None qos_preempt from QOS_PREEMPT.
+        self.qos_quantum_tokens = (
+            qos_quantum_tokens
+            if qos_quantum_tokens is not None
+            else int(os.environ.get("QOS_QUANTUM_TOKENS", "256") or 256)
+        )
+        self.qos_preempt = qos_preempt
         self._engines: dict[str, JaxChatEngine] = {}
         self._load_lock = asyncio.Lock()
         self._requests = 0
@@ -1330,6 +1352,8 @@ class LocalRegistry(Registry):
                 kv_block_tokens=self.kv_block_tokens,
                 kv_pool_blocks=self.kv_pool_blocks,
                 recorder=recorder,
+                qos_quantum_tokens=self.qos_quantum_tokens,
+                qos_preempt=self.qos_preempt,
                 **({"prefill_chunk": self.prefill_chunk}
                    if self.prefill_chunk else {}),
             )

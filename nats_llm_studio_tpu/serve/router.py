@@ -290,6 +290,21 @@ class ClusterRouter:
         self.stats = RouterStats()
         self._members: dict[str, WorkerAdvert] = {}
         self._sub = None
+        # router-local (worker_id, tenant) -> steered requests in flight:
+        # the pick tie-breaker that spreads ONE tenant's burst across
+        # workers instead of stacking it behind itself on the best-ranked
+        # one (other tenants' picks ignore it entirely)
+        self._tenant_inflight: dict[tuple[str, str], int] = {}
+
+    def _tenant_track(self, worker_id: str | None, tenant: str | None, d: int) -> None:
+        if not worker_id or not tenant:
+            return
+        k = (worker_id, tenant)
+        n = self._tenant_inflight.get(k, 0) + d
+        if n > 0:
+            self._tenant_inflight[k] = n
+        else:
+            self._tenant_inflight.pop(k, None)
 
     # -- membership ----------------------------------------------------------
 
@@ -359,16 +374,20 @@ class ClusterRouter:
         model: str | None = None,
         messages=None,
         excluded: tuple[str, ...] | list[str] = (),
+        tenant: str | None = None,
     ) -> str | None:
         """Best live worker id, or None (caller falls back to the queue
         group). Role-aware: see :meth:`pick_pair` (this is its first half)."""
-        return self.pick_pair(model=model, messages=messages, excluded=excluded)[0]
+        return self.pick_pair(
+            model=model, messages=messages, excluded=excluded, tenant=tenant
+        )[0]
 
     def pick_pair(
         self,
         model: str | None = None,
         messages=None,
         excluded: tuple[str, ...] | list[str] = (),
+        tenant: str | None = None,
     ) -> tuple[str | None, str | None]:
         """Role-aware pick: ``(serving_worker_id, prefill_worker_id)``.
 
@@ -417,6 +436,12 @@ class ClusterRouter:
                 m.load,  # depth per advertised slot: dp replicas count
                 m.queue_depth,
                 -m.kv_tier_depth,  # equal load: prefer the warmer KV tier
+                # tenant-aware tie-break: among equally loaded workers,
+                # steer away from the ones this SAME tenant already has
+                # steered requests in flight on — its burst spreads across
+                # the fleet instead of stacking behind itself
+                (self._tenant_inflight.get((m.worker_id, tenant), 0)
+                 if tenant else 0),
                 m.worker_id,  # total order: deterministic under ties
             )
             if best is None or key < best:
@@ -499,6 +524,9 @@ class ClusterRouter:
         inbound = parse_span_context(headers.get(p.TRACEPARENT_HEADER))
         parent_span_id = inbound[1] if inbound else ""
         excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
+        # gateway-stamped tenant identity: feeds the pick tie-breaker and
+        # the per-(worker, tenant) in-flight tracking below
+        tenant = headers.get(p.TENANT_HEADER) or None
         fallback = f"{self.prefix}.chat_model"
         last_exc: BaseException | None = None
         last_msg: Msg | None = None
@@ -511,7 +539,8 @@ class ClusterRouter:
             if excluded:
                 headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
             wid, prefill_wid = self.pick_pair(
-                model=model, messages=messages, excluded=excluded
+                model=model, messages=messages, excluded=excluded,
+                tenant=tenant,
             )
             if prefill_wid is not None and prefill_wid != wid:
                 # disaggregated two-hop: name the prefill-role worker the
@@ -538,6 +567,7 @@ class ClusterRouter:
                            "worker": wid or "queue-group", "outcome": "ok"}
             if headers.get(p.KV_PREFILL_HEADER):
                 attrs["prefill_worker"] = headers[p.KV_PREFILL_HEADER]
+            self._tenant_track(wid, tenant, +1)
             try:
                 try:
                     msg = await self.nc.request(
@@ -582,6 +612,7 @@ class ClusterRouter:
                         continue
                     return msg
             finally:
+                self._tenant_track(wid, tenant, -1)
                 await self._emit_span(Span(
                     trace_id=trace_id, span_id=span_id, stage="router.attempt",
                     worker_id=self.ident, parent_span_id=parent_span_id,
@@ -653,6 +684,7 @@ class ClusterRouter:
         inbound = parse_span_context(headers.get(p.TRACEPARENT_HEADER))
         parent_span_id = inbound[1] if inbound else ""
         excluded = p.parse_worker_list(headers.get(p.EXCLUDED_WORKERS_HEADER))
+        tenant = headers.get(p.TENANT_HEADER) or None
         fallback = f"{self.prefix}.chat_model"
         last_exc: BaseException | None = None
         last_msg: Msg | None = None
@@ -665,7 +697,8 @@ class ClusterRouter:
             if excluded:
                 headers[p.EXCLUDED_WORKERS_HEADER] = p.format_worker_list(excluded)
             wid, prefill_wid = self.pick_pair(
-                model=model, messages=messages, excluded=excluded
+                model=model, messages=messages, excluded=excluded,
+                tenant=tenant,
             )
             if prefill_wid is not None and prefill_wid != wid:
                 headers[p.KV_PREFILL_HEADER] = prefill_wid
@@ -692,6 +725,7 @@ class ClusterRouter:
                 subject, body, timeout=attempt_timeout,
                 idle_timeout=idle_timeout, headers=headers,
             )
+            self._tenant_track(wid, tenant, +1)
             try:
                 async for msg in stream:
                     terminal = bool(msg.headers and "Nats-Stream-Done" in msg.headers)
@@ -732,6 +766,7 @@ class ClusterRouter:
                 if not excluded:
                     headers.pop(p.EXCLUDED_WORKERS_HEADER, None)
             finally:
+                self._tenant_track(wid, tenant, -1)
                 # broke out (or the caller closed us): close the transport
                 # stream so its consumer-gone cancel reaches the worker
                 await stream.aclose()

@@ -54,7 +54,9 @@ from ..transport.protocol import (
     DEADLINE_HEADER,
     EXCLUDED_WORKERS_HEADER,
     KV_PREFILL_HEADER,
+    PRIORITY_HEADER,
     STREAM_CANCEL_SUFFIX,
+    TENANT_HEADER,
     TRACE_HEADER,
     TRACEPARENT_HEADER,
     WORKER_HEADER,
@@ -804,6 +806,16 @@ class Worker:
                 self.config.router_prefix_head_chars,
             ))
         payload["_trace"] = trace  # engines pop it; fakes ignore it
+        # tenant identity + priority class from the gateway-stamped bus
+        # headers (transport/protocol.py): engines pop them and thread them
+        # into the batcher's fair-share admission. Raw-NATS callers that
+        # never heard of tenancy set neither — the registry defaults them
+        # to the anonymous tenant at standard priority, so pre-QoS clients
+        # and tests see unchanged behavior.
+        if hdrs.get(TENANT_HEADER):
+            payload["_tenant"] = str(hdrs[TENANT_HEADER])
+        if hdrs.get(PRIORITY_HEADER):
+            payload["_priority"] = str(hdrs[PRIORITY_HEADER])
         if self.config.deadline_propagation:
             # client budget (X-Deadline-Ms, wall ms) → monotonic deadline
             # capped by the per-op ladder; the batcher sheds expired work at
@@ -1783,6 +1795,30 @@ class Worker:
             for cause, v in stats.shed_cause_counts().items():
                 r.counter("lmstudio_batcher_shed_by_cause_total", v,
                           labels={**labels, "cause": cause})
+            # multi-tenant QoS families (serve/qos.py): per-tenant serving
+            # counters under a capped ``tenant`` label — the top-K tenants
+            # by volume keep their own rows, the rest roll up into
+            # tenant="other" so a key-guessing client cannot mint unbounded
+            # label values
+            tstats = getattr(rb, "tenant_stats", None)
+            if tstats is not None:
+                topk = getattr(self.config, "qos_tenant_topk", 8)
+                for tenant, row in sorted(tstats.snapshot(topk).items()):
+                    tl = {**labels, "tenant": tenant}
+                    for key, fam in (
+                        ("requests", "lmstudio_tenant_requests_total"),
+                        ("served", "lmstudio_tenant_served_total"),
+                        ("shed", "lmstudio_tenant_shed_total"),
+                        ("preempted", "lmstudio_tenant_preempted_total"),
+                        ("tokens", "lmstudio_tenant_tokens_total"),
+                    ):
+                        r.counter(fam, row.get(key, 0), labels=tl)
+                    r.counter("lmstudio_tenant_queue_age_ms_total",
+                              round(row.get("queue_age_ms_sum", 0.0), 3),
+                              labels=tl,
+                              help="summed enqueue->admit wait ms of served "
+                                   "requests, by tenant (the fairness "
+                                   "signal: divide by served for the mean)")
             # deadline/brownout families — always present (zero-valued when
             # quiet) so overload dashboards can alert on the first increment
             causes = stats.shed_cause_counts()

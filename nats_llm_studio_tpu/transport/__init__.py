@@ -1,6 +1,6 @@
 from .client import ConnectionClosedError, Msg, NatsClient, RetryPolicy, Subscription, connect
 from .broker import EmbeddedBroker
-from .envelope import envelope_error, envelope_ok, is_retryable_envelope
+from .envelope import envelope_error, envelope_ok, is_retryable_envelope, shed_cause_of
 
 __all__ = [
     "ConnectionClosedError",
@@ -13,4 +13,5 @@ __all__ = [
     "envelope_error",
     "envelope_ok",
     "is_retryable_envelope",
+    "shed_cause_of",
 ]

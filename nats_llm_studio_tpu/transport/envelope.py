@@ -25,7 +25,47 @@ RETRYABLE_MARKERS = (
     "overloaded:",
     "shed after",
     "worker draining",
+    # every QoS shed carries a machine-readable cause token (below); the
+    # marker keeps a cause-stamped error retryable even if a future shed
+    # path forgets the human "retry ..." suffix
+    "shed_cause=",
 )
+
+# machine-readable shed causes a QoS-aware shed embeds in its error text as
+# a ``shed_cause=<cause>`` token (serve/batcher.py, gateway quota checks).
+# The gateway surfaces the cause in its 429/503 body instead of a generic
+# "overloaded", and picks the status from it: quota/fair_share are the
+# CALLER's budget (429 — retrying elsewhere cannot help), the rest are
+# worker-local pressure (503 — a peer may serve it).
+SHED_CAUSES = (
+    "quota",        # gateway: rate limit or monthly token quota
+    "fair_share",   # batcher: DRR/depth displacement by weighted fair share
+    "preempted",    # batcher: slot taken by a higher-priority admit
+    "brownout",     # batcher: load-shed level gated this class out
+    "depth",        # batcher: admit queue depth bound
+    "age",          # batcher: admit queue age bound
+    "kv_pool",      # batcher: block pool dry after reclaim+suspend
+    "deadline",     # batcher: client budget expired
+)
+
+
+def shed_cause(cause: str) -> str:
+    """The cause token to embed in a shed's error text."""
+    return f"shed_cause={cause}"
+
+
+def shed_cause_of(error) -> str | None:
+    """Extract the ``shed_cause=<cause>`` token from an error string (or a
+    decoded envelope's ``error`` field); None when absent/unknown — old
+    workers' cause-less sheds still read as generic overload."""
+    if isinstance(error, dict):
+        error = error.get("error", "")
+    low = str(error or "").lower()
+    i = low.find("shed_cause=")
+    if i < 0:
+        return None
+    tok = low[i + len("shed_cause="):].split()[0].strip(";,.()[]")
+    return tok if tok in SHED_CAUSES else None
 
 
 def error_is_retryable(error: str) -> bool:

@@ -229,6 +229,19 @@ EXCLUDED_WORKERS_HEADER = "X-Excluded-Workers"
 KV_PREFILL_HEADER = "X-KV-Prefill-Worker"
 
 
+# multi-tenant QoS (serve/qos.py): the gateway resolves an API key to a
+# tenant id + priority class and stamps both here; router → worker →
+# batcher read them so admission (deficit round-robin), brownout shedding
+# (batch < standard < premium), and preemption all know WHO is asking.
+# Absent headers (raw-NATS callers, every pre-QoS client) default to the
+# anonymous tenant at standard priority — tenancy is purely additive on
+# the wire. The priority value is clamped to the known classes at the
+# worker (qos.normalize_priority): a self-stamped bogus class degrades to
+# standard, it never grants premium.
+TENANT_HEADER = "X-Tenant"
+PRIORITY_HEADER = "X-Priority"
+
+
 # W3C traceparent-style span context (obs/trace.py): ``00-<trace>-<span>-01``
 # where <span> is the *sender's* span id — the receiving hop records it as
 # parent_span_id on the span it emits to ``{prefix}.obs.spans``, so the

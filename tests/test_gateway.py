@@ -135,10 +135,12 @@ class SlowRegistry(FakeRegistry):
 class GatewayHarness:
     """Embedded broker + N workers + one Gateway on an ephemeral port."""
 
-    def __init__(self, registries=None, n_workers=1, chat_timeout_s=5.0):
+    def __init__(self, registries=None, n_workers=1, chat_timeout_s=5.0,
+                 **gateway_kwargs):
         self.registries = registries
         self.n_workers = n_workers
         self.chat_timeout_s = chat_timeout_s
+        self.gateway_kwargs = gateway_kwargs
 
     async def __aenter__(self):
         self.broker = await EmbeddedBroker().start()
@@ -158,6 +160,7 @@ class GatewayHarness:
             self.nc, port=0, chat_timeout_s=self.chat_timeout_s,
             retry=RetryPolicy(max_attempts=2, backoff_s=0.01,
                               retry_on_timeout=True),
+            **self.gateway_kwargs,
         )
         await self.gw.start()
         return self
